@@ -1,0 +1,26 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus framework.
+
+From-scratch rebuild of the capabilities of Lighthouse (the Rust consensus
+client, see /root/reference) with the per-slot cryptographic hot path —
+batched BLS12-381 aggregate-verification and SSZ Merkleization — executed on
+TPU via JAX/XLA (jnp + Pallas kernels), and the host client logic written
+idiomatically in Python/C++ rather than translated from Rust.
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``ops/``      device kernels: SHA-256, Merkle reduction, 381-bit bigint,
+                field towers, curve ops, pairing (JAX/Pallas).
+- ``crypto/``   host crypto API: BLS backend seam (tpu / python / fake),
+                hashing, keystores, key derivation.
+- ``ssz/``      SimpleSerialize encode/decode, typed containers, tree hash,
+                merkle proofs.
+- ``types/``    consensus datatypes across forks, EthSpec presets, ChainSpec.
+- ``state_transition/``  pure spec state transition + signature-set batching.
+- ``fork_choice/``       proto-array LMD-GHOST.
+- ``store/``    hot/cold storage.
+- ``chain/``    beacon chain runtime: verification pipelines, op pool, head.
+- ``parallel/`` device mesh / sharding helpers for multi-chip scaling.
+- ``utils/``    metrics, slot clock, logging, safe arithmetic.
+"""
+
+__version__ = "0.1.0"

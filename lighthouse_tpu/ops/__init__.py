@@ -1,0 +1,1 @@
+"""Device kernels (JAX/XLA + Pallas) for the consensus hot path."""

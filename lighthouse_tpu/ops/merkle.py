@@ -1,0 +1,129 @@
+"""Device-side SSZ Merkleization: batched binary-tree SHA-256 reduction.
+
+TPU counterpart of the reference's ``consensus/tree_hash`` streaming
+``MerkleHasher`` (``/root/reference/consensus/tree_hash/src/merkle_hasher.rs``)
+and the padded ``merkleize_padded``.  Where the reference folds one leaf at a
+time through per-level SHA contexts to minimise allocation, a TPU wants the
+opposite shape: the *whole level* hashed as one batched ``hash64`` launch,
+level by level, with XLA fusing the 128 compression rounds across the lane
+dimension.  Zero-subtree padding uses the same precomputed zero-hash table as
+the reference (``/root/reference/crypto/eth2_hashing/src/lib.rs:205-217``,
+``ZERO_HASHES`` to depth 48).
+
+Leaves are ``(n, 8)`` uint32 arrays (32-byte chunks as big-endian words).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .sha256 import hash64, bytes_to_words, words_to_bytes
+
+MAX_TREE_DEPTH = 64
+
+# ZERO_HASHES[i] = root of a depth-i tree of zero leaves.
+_zh = [b"\x00" * 32]
+for _ in range(MAX_TREE_DEPTH):
+    _zh.append(hashlib.sha256(_zh[-1] + _zh[-1]).digest())
+ZERO_HASHES = np.stack([bytes_to_words(h) for h in _zh])  # (65, 8) uint32
+ZERO_HASHES_BYTES = list(_zh)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def merkleize(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
+    """Root of a depth-``depth`` tree over ``leaves`` ``(n, 8)`` u32, n = 2^k ≤ 2^depth.
+
+    The first ``ceil_log2(n)`` levels reduce the real leaves; remaining levels
+    combine with the constant zero-hash of that level (the standard
+    ``merkleize_padded`` trick — no materialised padding).
+    """
+    n = leaves.shape[0]
+    assert n == _next_pow2(n), "pad leaf count to a power of two first"
+    level = leaves
+    lvl = 0
+    while level.shape[0] > 1:
+        level = hash64(level[0::2], level[1::2])
+        lvl += 1
+    root = level[0]
+    while lvl < depth:
+        root = hash64(root, jnp.asarray(ZERO_HASHES[lvl]))
+        lvl += 1
+    return root
+
+
+@jax.jit
+def merkle_level(left_right: jnp.ndarray) -> jnp.ndarray:
+    """One tree level: ``(n, 8)`` → ``(n/2, 8)`` (n even)."""
+    return hash64(left_right[0::2], left_right[1::2])
+
+
+@jax.jit
+def mix_in_length(root: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
+    """``hash(root || uint256_le(length))`` — SSZ list length mixin.
+
+    Mirrors ``/root/reference/consensus/tree_hash/src/lib.rs:61-69``.
+    ``length`` is a uint32 scalar (consensus list lengths fit; widen later via
+    a (2,) lo/hi pair if a >4B-entry list ever appears).
+    """
+    # little-endian uint256: byte 0..3 = length LE -> big-endian word 0
+    le = ((length & np.uint32(0xFF)) << np.uint32(24)) \
+        | ((length >> np.uint32(8) & np.uint32(0xFF)) << np.uint32(16)) \
+        | ((length >> np.uint32(16) & np.uint32(0xFF)) << np.uint32(8)) \
+        | (length >> np.uint32(24))
+    len_words = jnp.zeros(8, dtype=jnp.uint32).at[0].set(le)
+    return hash64(root, len_words)
+
+
+def subtree_then_zero_root(leaves: jnp.ndarray, depth: int,
+                           length: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Root of a 2^depth-leaf tree where only a power-of-two prefix is real.
+
+    This is the hot shape for the validator registry: ~1M real leaves inside a
+    2^40-leaf SSZ list (``ValidatorRegistryLimit``,
+    ``/root/reference/consensus/types/src/eth_spec.rs:267``).  Optionally mixes
+    in the list length.
+    """
+    root = merkleize(leaves, depth)
+    if length is not None:
+        root = mix_in_length(root, jnp.asarray(length, dtype=jnp.uint32))
+    return root
+
+
+# ---------------------------------------------------------------------------
+# Host-side reference (ground truth + cold paths)
+# ---------------------------------------------------------------------------
+
+def merkleize_host(chunks: list[bytes], limit: int | None = None) -> bytes:
+    """Host merkleize per the SSZ spec (power-of-two zero padding up to limit)."""
+    count = len(chunks)
+    if limit is not None and count > limit:
+        raise ValueError(f"{count} chunks exceeds limit {limit}")
+    width = _next_pow2(count if limit is None else limit)
+    depth = width.bit_length() - 1
+    if count == 0:
+        return ZERO_HASHES_BYTES[depth]
+    level = list(chunks)
+    for d in range(depth):
+        if len(level) % 2 == 1:
+            level.append(ZERO_HASHES_BYTES[d])
+        level = [hashlib.sha256(level[i] + level[i + 1]).digest()
+                 for i in range(0, len(level), 2)]
+    return level[0]
+
+
+def mix_in_length_host(root: bytes, length: int) -> bytes:
+    return hashlib.sha256(root + length.to_bytes(32, "little")).digest()
+
+
+def mix_in_selector_host(root: bytes, selector: int) -> bytes:
+    """SSZ union selector mixin (``tree_hash/src/lib.rs:84-95``)."""
+    return hashlib.sha256(root + selector.to_bytes(32, "little")).digest()

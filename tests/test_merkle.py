@@ -1,0 +1,46 @@
+"""Device Merkleization vs host reference."""
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import merkle, sha256 as dsha
+
+
+def _rand_chunks(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.bytes(32) for _ in range(n)]
+
+
+def test_zero_hashes():
+    assert merkle.ZERO_HASHES_BYTES[0] == b"\x00" * 32
+    assert merkle.ZERO_HASHES_BYTES[1] == hashlib.sha256(b"\x00" * 64).digest()
+
+
+def test_merkleize_host_spec_cases():
+    c = _rand_chunks(3)
+    # 3 chunks, no limit -> width 4
+    h01 = hashlib.sha256(c[0] + c[1]).digest()
+    h23 = hashlib.sha256(c[2] + b"\x00" * 32).digest()
+    assert merkle.merkleize_host(c) == hashlib.sha256(h01 + h23).digest()
+    # empty with limit
+    assert merkle.merkleize_host([], limit=16) == merkle.ZERO_HASHES_BYTES[4]
+    # single chunk no limit = itself
+    assert merkle.merkleize_host([c[0]]) == c[0]
+
+
+def test_device_merkleize_matches_host():
+    for n, depth in [(1, 0), (2, 1), (8, 3), (8, 10), (64, 6), (64, 40)]:
+        chunks = _rand_chunks(n, seed=n + depth)
+        leaves = jnp.asarray(np.stack([dsha.bytes_to_words(ch) for ch in chunks]))
+        got = dsha.words_to_bytes(np.asarray(merkle.merkleize(leaves, depth)))
+        want = merkle.merkleize_host(chunks, limit=1 << depth)
+        assert got == want, (n, depth)
+
+
+def test_mix_in_length():
+    root = _rand_chunks(1)[0]
+    leaves = jnp.asarray(dsha.bytes_to_words(root))
+    got = merkle.mix_in_length(leaves, jnp.uint32(123456789))
+    assert dsha.words_to_bytes(np.asarray(got)) == merkle.mix_in_length_host(root, 123456789)

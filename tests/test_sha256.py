@@ -1,0 +1,51 @@
+"""Device SHA-256 vs hashlib ground truth."""
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from lighthouse_tpu.ops import sha256 as dsha
+
+
+def test_hash64_matches_hashlib():
+    rng = np.random.default_rng(0)
+    left = rng.integers(0, 2**32, size=(33, 8), dtype=np.uint32)
+    right = rng.integers(0, 2**32, size=(33, 8), dtype=np.uint32)
+    out = np.asarray(dsha.hash64(jnp.asarray(left), jnp.asarray(right)))
+    for i in range(left.shape[0]):
+        msg = dsha.words_to_bytes(left[i]) + dsha.words_to_bytes(right[i])
+        expect = hashlib.sha256(msg).digest()
+        assert dsha.words_to_bytes(out[i]) == expect
+
+
+def test_hash64_scalar_shape():
+    l = jnp.zeros(8, dtype=jnp.uint32)
+    out = dsha.hash64(l, l)
+    assert out.shape == (8,)
+    assert dsha.words_to_bytes(np.asarray(out)) == hashlib.sha256(b"\x00" * 64).digest()
+
+
+def test_hash_blocks_one_block():
+    # 64-byte message padded to two blocks must equal hashlib.
+    msg = bytes(range(64))
+    words = dsha.bytes_to_words(msg)
+    nblocks, tail, mask = dsha.pad_message_np(64)
+    assert nblocks == 2
+    data = np.zeros(nblocks * 16, dtype=np.uint32)
+    data[:16] = words
+    data = (data & mask) | tail
+    out = dsha.hash_blocks(jnp.asarray(data.reshape(nblocks, 16)))
+    assert dsha.words_to_bytes(np.asarray(out)) == hashlib.sha256(msg).digest()
+
+
+def test_pad_message_short():
+    # 5-byte message: single block.
+    msg = b"hello"
+    nblocks, tail, mask = dsha.pad_message_np(len(msg))
+    assert nblocks == 1
+    padded = msg + b"\x00" * (nblocks * 64 - len(msg))
+    data = dsha.bytes_to_words(padded)
+    data = (data & mask) | tail
+    out = dsha.hash_blocks(jnp.asarray(data.reshape(nblocks, 16)))
+    assert dsha.words_to_bytes(np.asarray(out)) == hashlib.sha256(msg).digest()
