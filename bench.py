@@ -1,0 +1,77 @@
+"""Headline benchmark: full-state-scale Merkleization on TPU vs CPU.
+
+Measures the device Merkle reduction over 2^21 32-byte chunks — the leaf
+count of a ~1M-validator registry at one chunk per validator-record root,
+the dominant tree in ``BeaconState::hash_tree_root``
+(``/root/reference/consensus/types/src/beacon_state/tree_hash_cache.rs:332``)
+— against a single-thread CPU SHA-256 baseline (hashlib, i.e. the same
+OpenSSL SHA-NI code path the reference's ``eth2_hashing`` dispatches to).
+The CPU baseline is measured on a 2^16-leaf slice and scaled linearly
+(hash count is exactly linear in leaves).
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}``
+(``vs_baseline`` = CPU time / TPU time; >1 means faster than baseline).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+import numpy as np
+
+
+DEPTH = 21          # 2^21 leaves ≈ 1M-validator registry scale
+CPU_DEPTH = 16      # baseline slice, scaled by 2**(DEPTH - CPU_DEPTH)
+WARMUP = 2
+RUNS = 5
+
+
+def _cpu_merkle_ms(leaves_bytes: list[bytes]) -> float:
+    t0 = time.perf_counter()
+    level = leaves_bytes
+    sha = hashlib.sha256
+    while len(level) > 1:
+        level = [sha(level[i] + level[i + 1]).digest()
+                 for i in range(0, len(level), 2)]
+    return (time.perf_counter() - t0) * 1e3
+
+
+def main() -> None:
+    import jax
+    from lighthouse_tpu.ops.merkle import merkleize
+
+    n = 1 << DEPTH
+    rng = np.random.default_rng(0)
+    leaves = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint64).astype(np.uint32)
+    leaves_dev = jax.device_put(leaves)
+
+    # np.asarray forces a host transfer of the 32-byte root: the only
+    # reliable completion barrier on the experimental axon platform, where
+    # block_until_ready returns at dispatch.  Transfer cost is one digest.
+    for _ in range(WARMUP):
+        np.asarray(merkleize(leaves_dev, DEPTH))
+    times = []
+    for _ in range(RUNS):
+        t0 = time.perf_counter()
+        np.asarray(merkleize(leaves_dev, DEPTH))
+        times.append((time.perf_counter() - t0) * 1e3)
+    tpu_ms = min(times)
+
+    m = 1 << CPU_DEPTH
+    blob = leaves[:m].astype(">u4").tobytes()
+    cpu_leaves = [blob[i * 32:(i + 1) * 32] for i in range(m)]
+    cpu_ms = _cpu_merkle_ms(cpu_leaves) * (n / m)
+
+    print(json.dumps({
+        "metric": f"merkle_root_{n}_leaves",
+        "value": round(tpu_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / tpu_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

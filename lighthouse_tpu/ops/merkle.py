@@ -38,18 +38,27 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
-@partial(jax.jit, static_argnames=("depth",))
-def merkleize(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("depth", "base_level"))
+def merkleize(leaves: jnp.ndarray, depth: int, base_level: int = 0) -> jnp.ndarray:
     """Root of a depth-``depth`` tree over ``leaves`` ``(n, 8)`` u32, n = 2^k ≤ 2^depth.
 
     The first ``ceil_log2(n)`` levels reduce the real leaves; remaining levels
     combine with the constant zero-hash of that level (the standard
     ``merkleize_padded`` trick — no materialised padding).
+
+    ``base_level``: tree level the input nodes already sit at (0 = 32-byte
+    chunks).  Non-zero when reducing subtree roots produced elsewhere — e.g.
+    per-device partial roots in the sharded reduction
+    (:mod:`lighthouse_tpu.parallel.merkle_shard`) — so that zero-subtree
+    padding uses the correct ``ZERO_HASHES`` entries.  ``depth`` remains the
+    *total* tree depth counted from level 0.
     """
     n = leaves.shape[0]
     assert n == _next_pow2(n), "pad leaf count to a power of two first"
+    assert base_level + (n - 1).bit_length() <= depth, \
+        f"{n} nodes at level {base_level} overflow a depth-{depth} tree"
     level = leaves
-    lvl = 0
+    lvl = base_level
     while level.shape[0] > 1:
         level = hash64(level[0::2], level[1::2])
         lvl += 1

@@ -1,0 +1,12 @@
+"""Multi-chip scaling: device mesh construction + sharded hot-path kernels.
+
+The reference scales its per-slot crypto with rayon across CPU cores
+(``/root/reference/consensus/state_processing/src/per_block_processing/block_signature_verifier.rs:392-405``,
+``consensus/types/src/beacon_state/tree_hash_cache.rs:535``).  The TPU-native
+equivalent is a single batched kernel sharded over an ICI mesh with
+``shard_map``/``pjit``, with cross-chip reduction (sub-tree Merkle roots,
+pairing partial products) riding XLA collectives.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .merkle_shard import sharded_merkle_root  # noqa: F401

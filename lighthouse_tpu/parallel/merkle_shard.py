@@ -1,0 +1,64 @@
+"""Sharded Merkle-tree reduction over a device mesh.
+
+The 1M-validator registry tree (depth 40+1,
+``/root/reference/consensus/types/src/eth_spec.rs:267``) is the dominant
+``hash_tree_root`` workload.  On a multi-chip mesh we split the leaf range
+over the ``batch`` axis, reduce each contiguous sub-range to its sub-tree
+root entirely on-chip with ``shard_map`` (zero communication — leaf ranges
+are power-of-two aligned so each shard owns a whole sub-tree), all-gather
+the per-chip roots over ICI, and fold the remaining ``log2(n_chips)`` +
+zero-padding levels replicated.  The reference's equivalent is rayon over
+4096-validator arenas (``tree_hash_cache.rs:25-33,535-556``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.merkle import merkleize
+from ..ops.sha256 import hash64  # noqa: F401  (re-exported for kernel callers)
+from .mesh import BATCH_AXIS
+
+
+def _log2(n: int) -> int:
+    assert n & (n - 1) == 0 and n > 0, f"{n} not a power of two"
+    return n.bit_length() - 1
+
+
+@partial(jax.jit, static_argnames=("depth", "mesh"))
+def sharded_merkle_root(leaves: jnp.ndarray, mesh: Mesh, depth: int) -> jnp.ndarray:
+    """Root of a depth-``depth`` padded tree over ``leaves`` ``(n, 8)`` u32.
+
+    ``n`` must be a power of two divisible by the mesh size.  The input is
+    (re)sharded contiguously over the ``batch`` axis; output is the
+    replicated ``(8,)`` root.
+    """
+    n = leaves.shape[0]
+    ndev = mesh.shape[BATCH_AXIS]
+    assert n % ndev == 0, (n, ndev)
+    local_n = n // ndev
+    local_depth = _log2(local_n)
+    assert depth >= local_depth + _log2(ndev)
+
+    leaves = jax.lax.with_sharding_constraint(
+        leaves, NamedSharding(mesh, P(BATCH_AXIS)))
+
+    def local_subtree(chunk):
+        # chunk: (local_n, 8) — one whole aligned sub-tree per device.
+        return merkleize(chunk, local_depth)[None]  # (1, 8)
+
+    # check_vma=False: the SHA round scan seeds its carry with the constant
+    # IV (unvarying) and folds in the sharded block, which trips the
+    # varying-manual-axes check; semantics are still purely per-shard.
+    roots = shard_map(
+        local_subtree, mesh=mesh,
+        in_specs=P(BATCH_AXIS), out_specs=P(BATCH_AXIS),
+        check_vma=False,
+    )(leaves)  # (ndev, 8), sharded — the following gather rides ICI.
+
+    return merkleize(roots, depth, base_level=local_depth)

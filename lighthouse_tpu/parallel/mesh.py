@@ -1,0 +1,32 @@
+"""Device-mesh construction for the crypto data plane.
+
+One logical axis, ``batch``: every hot-path workload (signature sets, Merkle
+leaves, shuffle indices) is embarrassingly parallel over its batch dimension,
+so the natural mesh is 1-D data-parallel over all chips — collectives only
+appear at the final cross-chip reduction (sub-tree roots / pairing product).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D ``batch`` mesh over ``devices`` (default: all available)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices).reshape(-1), (BATCH_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 over the batch axis, replicate the rest."""
+    return NamedSharding(mesh, P(BATCH_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,17 +1,24 @@
 """Test harness configuration.
 
-Forces JAX onto the CPU backend with 8 virtual devices BEFORE jax is imported
-anywhere, so multi-chip sharding (Mesh/pjit/shard_map) is exercised hermetically
-— mirroring how the reference tests multi-node behaviour in one process
-(``/root/reference/testing/node_test_rig``).  Real-TPU runs (bench.py) do not
-import this.
+Forces JAX onto the CPU backend with 8 virtual devices, so multi-chip
+sharding (Mesh/pjit/shard_map) is exercised hermetically — mirroring how the
+reference tests multi-node behaviour in one process
+(``/root/reference/testing/node_test_rig``).  Real-TPU runs (bench.py) do
+not import this.
+
+Note: this environment's sitecustomize imports jax at interpreter start and
+pins ``JAX_PLATFORMS=axon``, so env vars alone are too late here — we update
+jax's config directly (backends are still uninitialised when conftest runs,
+so ``XLA_FLAGS`` for the host device count still takes effect).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
