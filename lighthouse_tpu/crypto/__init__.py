@@ -1,0 +1,9 @@
+"""Host crypto: BLS12-381 reference implementation + backend seam.
+
+Ground-truth, pure-Python BLS12-381 (fields, curves, pairing, hash-to-curve,
+signatures) mirroring the semantics of the reference's ``crypto/bls`` crate
+(``/root/reference/crypto/bls``).  The device (JAX/Pallas) backend in
+``lighthouse_tpu.ops`` is validated against this module, exactly as the
+reference validates blst against milagro/fake_crypto
+(``/root/reference/crypto/bls/src/lib.rs:8-21``).
+"""

@@ -17,12 +17,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from ..ops.merkle import merkleize
-from ..ops.sha256 import hash64  # noqa: F401  (re-exported for kernel callers)
-from .mesh import BATCH_AXIS
+from .mesh import BATCH_AXIS, batch_sharding
 
 
 def _log2(n: int) -> int:
@@ -45,8 +44,7 @@ def sharded_merkle_root(leaves: jnp.ndarray, mesh: Mesh, depth: int) -> jnp.ndar
     local_depth = _log2(local_n)
     assert depth >= local_depth + _log2(ndev)
 
-    leaves = jax.lax.with_sharding_constraint(
-        leaves, NamedSharding(mesh, P(BATCH_AXIS)))
+    leaves = jax.lax.with_sharding_constraint(leaves, batch_sharding(mesh))
 
     def local_subtree(chunk):
         # chunk: (local_n, 8) — one whole aligned sub-tree per device.

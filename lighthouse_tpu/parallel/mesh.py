@@ -29,4 +29,5 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (constants: zero-hash tables, generators)."""
     return NamedSharding(mesh, P())
