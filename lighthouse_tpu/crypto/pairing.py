@@ -18,7 +18,6 @@ from typing import Iterable, Optional, Tuple
 
 from . import fields as F
 from .fields import P, BLS_X
-from .curve import FQ2 as _FQ2V  # field vtable for Fq2 (b constant unused here)
 
 _X_ABS = -BLS_X  # positive 0xd201000000010000
 _X_BITS = bin(_X_ABS)[3:]  # MSB-first, top bit dropped (implicit leading 1)

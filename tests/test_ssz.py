@@ -1,0 +1,351 @@
+"""SSZ layer tests.
+
+Known-answer vectors from the SSZ spec (simple-serialize examples) plus
+independently-computed Merkle roots (straight hashlib here, never the
+package's own merkleize) — the strategy the reference applies via
+``ssz_static`` EF vectors (``/root/reference/testing/ef_tests``).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Container,
+    List,
+    SszError,
+    Vector,
+    boolean,
+    uint16,
+    uint64,
+    uint256,
+)
+
+
+def sha(x: bytes) -> bytes:
+    return hashlib.sha256(x).digest()
+
+
+def pad32(b: bytes) -> bytes:
+    return b.ljust(32, b"\x00")
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+def test_uint_serialize_spec_example():
+    assert uint16.serialize(0x4567) == bytes([0x67, 0x45])
+    assert uint16.deserialize(bytes([0x67, 0x45])) == 0x4567
+    assert uint64.serialize(2**64 - 1) == b"\xff" * 8
+    with pytest.raises(SszError):
+        uint16.serialize(2**16)
+    with pytest.raises(SszError):
+        uint64.deserialize(b"\x00" * 7)
+
+
+def test_uint256_roundtrip():
+    v = 2**200 + 12345
+    data = uint256.serialize(v)
+    assert len(data) == 32
+    assert uint256.deserialize(data) == v
+    assert uint256.hash_tree_root(v) == data
+
+
+def test_boolean():
+    assert boolean.serialize(True) == b"\x01"
+    assert boolean.deserialize(b"\x00") is False
+    with pytest.raises(SszError):
+        boolean.deserialize(b"\x02")
+
+
+def test_uint_htr_is_padded_le():
+    assert uint64.hash_tree_root(5) == pad32((5).to_bytes(8, "little"))
+
+
+# ---------------------------------------------------------------------------
+# Byte vectors / lists
+# ---------------------------------------------------------------------------
+
+def test_bytes32_htr_identity():
+    v = bytes(range(32))
+    assert Bytes32.hash_tree_root(v) == v
+    assert Bytes32.serialize(v) == v
+    with pytest.raises(SszError):
+        Bytes32.serialize(b"\x00" * 31)
+
+
+def test_bytes48_htr():
+    from lighthouse_tpu.ssz import Bytes48
+    v = bytes(range(48))
+    # two chunks: v[0:32], v[32:48] zero-padded
+    assert Bytes48.hash_tree_root(v) == sha(v[:32] + pad32(v[32:]))
+
+
+def test_bytelist_htr():
+    BL = ByteList(96)  # 3-chunk limit -> depth 2 tree
+    v = b"\xaa" * 33
+    z = b"\x00" * 32
+    leaves = [v[:32], pad32(v[32:]), z, z]
+    root = sha(sha(leaves[0] + leaves[1]) + sha(leaves[2] + leaves[3]))
+    expect = sha(root + (33).to_bytes(32, "little"))
+    assert BL.hash_tree_root(v) == expect
+    assert BL.deserialize(BL.serialize(v)) == v
+
+
+# ---------------------------------------------------------------------------
+# Vector / List
+# ---------------------------------------------------------------------------
+
+def test_vector_uint64_serialize_and_htr():
+    V = Vector(uint64, 8)
+    vals = np.arange(8, dtype=np.uint64)
+    data = V.serialize(vals)
+    assert data == vals.tobytes()
+    back = V.deserialize(data)
+    assert np.array_equal(back, vals)
+    chunk0 = data[:32]
+    chunk1 = data[32:]
+    assert V.hash_tree_root(vals) == sha(chunk0 + chunk1)
+
+
+def test_vector_length_enforced():
+    V = Vector(uint64, 4)
+    with pytest.raises(SszError):
+        V.serialize([1, 2, 3])
+    with pytest.raises(SszError):
+        V.deserialize(b"\x00" * 24)
+
+
+def test_list_uint64_htr_with_limit():
+    L = List(uint64, 16)  # 4-chunk limit -> depth-2 tree + length mixin
+    vals = np.array([1, 2, 3, 4, 5], dtype=np.uint64)
+    data = vals.tobytes()
+    c0, c1 = data[:32], pad32(data[32:])
+    z = b"\x00" * 32
+    root = sha(sha(c0 + c1) + sha(z + z))
+    expect = sha(root + (5).to_bytes(32, "little"))
+    assert L.hash_tree_root(vals) == expect
+
+
+def test_empty_list_htr():
+    L = List(uint64, 8)  # 2-chunk limit
+    z = b"\x00" * 32
+    expect = sha(sha(z + z) + (0).to_bytes(32, "little"))
+    assert L.hash_tree_root([]) == expect
+    assert L.serialize([]) == b""
+    assert len(L.deserialize(b"")) == 0
+
+
+def test_list_limit_enforced():
+    L = List(uint64, 4)
+    with pytest.raises(SszError):
+        L.serialize(np.arange(5, dtype=np.uint64))
+
+
+def test_list_of_variable_roundtrip():
+    BL = ByteList(64)
+    L = List(BL, 10)
+    vals = [b"", b"\x01\x02", b"\x03" * 50]
+    data = L.serialize(vals)
+    # offset table: 3 * 4 bytes, offsets 12, 12, 14
+    assert data[:4] == (12).to_bytes(4, "little")
+    assert data[4:8] == (12).to_bytes(4, "little")
+    assert data[8:12] == (14).to_bytes(4, "little")
+    assert L.deserialize(data) == vals
+
+
+def test_list_of_variable_bad_offsets():
+    BL = ByteList(64)
+    L = List(BL, 10)
+    with pytest.raises(SszError):
+        L.deserialize((3).to_bytes(4, "little"))  # misaligned first offset
+    with pytest.raises(SszError):
+        L.deserialize((8).to_bytes(4, "little") + (20).to_bytes(4, "little"))
+
+
+# ---------------------------------------------------------------------------
+# Bitfields
+# ---------------------------------------------------------------------------
+
+def test_bitvector_serialize():
+    B = Bitvector(10)
+    bits = np.zeros(10, dtype=bool)
+    bits[0] = bits[9] = True
+    data = B.serialize(bits)
+    assert data == bytes([0b0000_0001, 0b0000_0010])
+    assert np.array_equal(B.deserialize(data), bits)
+    with pytest.raises(SszError):  # padding bit set
+        B.deserialize(bytes([0x01, 0b0000_0100]))
+
+
+def test_bitlist_delimiter():
+    B = Bitlist(16)
+    bits = np.array([1, 0, 1], dtype=bool)
+    data = B.serialize(bits)
+    assert data == bytes([0b0000_1101])  # bits 101 + delimiter at index 3
+    assert np.array_equal(B.deserialize(data), bits)
+    assert B.serialize(np.zeros(0, dtype=bool)) == b"\x01"
+    assert len(B.deserialize(b"\x01")) == 0
+    with pytest.raises(SszError):
+        B.deserialize(b"\x00")  # no delimiter
+    with pytest.raises(SszError):
+        B.deserialize(b"")
+
+
+def test_bitlist_htr():
+    B = Bitlist(256)  # 1-chunk limit
+    bits = np.array([1, 1, 0, 1], dtype=bool)
+    chunk = pad32(bytes([0b0000_1011]))
+    expect = sha(chunk + (4).to_bytes(32, "little"))
+    assert B.hash_tree_root(bits) == expect
+
+
+def test_bitlist_limit():
+    B = Bitlist(4)
+    with pytest.raises(SszError):
+        B.deserialize(bytes([0b0010_0000]))  # 5 bits
+
+
+# ---------------------------------------------------------------------------
+# Containers
+# ---------------------------------------------------------------------------
+
+class Small(Container):
+    a: uint16
+    b: uint16
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class WithList(Container):
+    tag: uint64
+    items: List(uint64, 8)
+    name: ByteList(16)
+
+
+def test_small_container_spec_example():
+    s = Small(a=0x4567, b=0x0123)
+    assert s.encode() == bytes([0x67, 0x45, 0x23, 0x01])
+    back = Small.deserialize(bytes([0x67, 0x45, 0x23, 0x01]))
+    assert back == s
+    assert Small.is_fixed_size() and Small.fixed_size() == 4
+
+
+def test_container_htr():
+    c = Checkpoint(epoch=3, root=b"\x42" * 32)
+    expect = sha(pad32((3).to_bytes(8, "little")) + b"\x42" * 32)
+    assert c.tree_hash_root() == expect
+
+
+def test_container_defaults():
+    c = Checkpoint()
+    assert c.epoch == 0 and c.root == b"\x00" * 32
+
+
+def test_container_with_variable_fields_roundtrip():
+    w = WithList(tag=7, items=np.array([1, 2, 3], dtype=np.uint64),
+                 name=b"abc")
+    data = w.encode()
+    # fixed part: 8 (tag) + 4 (offset) + 4 (offset) = 16
+    assert data[8:12] == (16).to_bytes(4, "little")
+    assert data[12:16] == (16 + 24).to_bytes(4, "little")
+    back = WithList.deserialize(data)
+    assert back.tag == 7
+    assert np.array_equal(back.items, w.items)
+    assert back.name == b"abc"
+
+
+def test_container_deserialize_rejects_bad_offset():
+    w = WithList(tag=7, items=np.array([1], dtype=np.uint64), name=b"x")
+    data = bytearray(w.encode())
+    data[8] = 99  # corrupt first offset
+    with pytest.raises(SszError):
+        WithList.deserialize(bytes(data))
+
+
+def test_container_htr_with_list_field():
+    w = WithList()
+    z = b"\x00" * 32
+    items_root = sha(sha(z + z) + (0).to_bytes(32, "little"))
+    name_root = sha(z + (0).to_bytes(32, "little"))
+    tag_root = z
+    # 3 fields -> 4-leaf tree
+    expect = sha(sha(tag_root + items_root) + sha(name_root + z))
+    assert w.tree_hash_root() == expect
+
+
+def test_container_copy_is_deep_for_mutables():
+    w = WithList(tag=1, items=np.array([1, 2], dtype=np.uint64), name=b"x")
+    w2 = w.copy()
+    w2.items[0] = 99
+    assert w.items[0] == 1
+
+
+def test_nested_containers():
+    class Outer(Container):
+        inner: Checkpoint
+        flag: boolean
+
+    o = Outer(inner=Checkpoint(epoch=1, root=b"\x01" * 32), flag=True)
+    back = Outer.deserialize(o.encode())
+    assert back == o
+    expect = sha(
+        sha(pad32((1).to_bytes(8, "little")) + b"\x01" * 32)
+        + pad32(b"\x01"))
+    assert o.tree_hash_root() == expect
+
+
+def test_vector_of_containers():
+    V = Vector(Checkpoint, 2)
+    vals = [Checkpoint(epoch=1), Checkpoint(epoch=2)]
+    back = V.deserialize(V.serialize(vals))
+    assert back == vals
+    expect = sha(vals[0].tree_hash_root() + vals[1].tree_hash_root())
+    assert V.hash_tree_root(vals) == expect
+
+
+# ---------------------------------------------------------------------------
+# Regression: review findings
+# ---------------------------------------------------------------------------
+
+def test_basic_seq_rejects_out_of_range():
+    V = Vector(uint64, 2)
+    with pytest.raises(SszError):
+        V.serialize(np.array([-1, 5], dtype=np.int64))
+    with pytest.raises(SszError):
+        V.serialize(np.array([1.7, 2.0]))
+    with pytest.raises(SszError):
+        Vector(uint16, 2).serialize(np.array([70000, 1], dtype=np.int64))
+    # widening cast of in-range values is fine
+    assert Vector(uint64, 2).serialize(np.array([1, 2], dtype=np.uint8)) \
+        == (1).to_bytes(8, "little") + (2).to_bytes(8, "little")
+
+
+def test_uint_rejects_float():
+    with pytest.raises(SszError):
+        uint64.serialize(1.7)
+
+
+def test_pep563_string_annotations_resolve():
+    src = (
+        "from __future__ import annotations\n"
+        "from lighthouse_tpu.ssz import Container, uint64, Bytes32\n"
+        "class Cp(Container):\n"
+        "    epoch: uint64\n"
+        "    root: Bytes32\n"
+    )
+    ns = {}
+    exec(compile(src, "<pep563>", "exec"), ns)
+    Cp = ns["Cp"]
+    assert list(Cp.FIELDS) == ["epoch", "root"]
+    c = Cp(epoch=9)
+    assert Cp.deserialize(c.encode()) == c
