@@ -497,6 +497,8 @@ class Container(SszType, metaclass=ContainerMeta):
                 v = v.copy()
             elif isinstance(v, np.ndarray):
                 v = v.copy()
+            elif getattr(v, "__ssz_mutable__", False):
+                v = v.copy()  # e.g. the SoA ValidatorRegistry
             elif isinstance(v, list):
                 v = [e.copy() if isinstance(e, Container)
                      else (e.copy() if isinstance(e, np.ndarray) else e)

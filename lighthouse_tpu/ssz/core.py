@@ -22,7 +22,6 @@ from ..ops.merkle import merkleize_host, mix_in_length_host
 
 BYTES_PER_CHUNK = 32
 BYTES_PER_LENGTH_OFFSET = 4
-MAX_OFFSET = 2**32
 
 
 class SszError(ValueError):

@@ -1,0 +1,304 @@
+"""Validator registry as a structure-of-arrays (SoA) — the TPU-first redesign
+of the reference's ``List<Validator, ValidatorRegistryLimit>``.
+
+The reference stores validators as an array-of-structs and parallelises
+hashing with rayon over 4096-record arenas
+(``/root/reference/consensus/types/src/beacon_state/tree_hash_cache.rs:25-33,
+535-556``).  On TPU the natural layout is columnar: each field is one numpy
+array, so
+
+- epoch processing (rewards, effective-balance updates, registry updates)
+  is vectorized arithmetic over whole columns (no per-validator Python);
+- the registry Merkle root is ONE batched device program: 8 chunk-leaves per
+  validator, three ``hash64`` levels to per-validator roots, then the big
+  padded reduction to the 2^40-leaf registry root
+  (``consensus/types/src/validator.rs`` field order defines the leaves).
+
+``Validator`` (the AoS container) remains the single-record interchange type;
+the registry converts at the boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ssz.core import Bytes32, Bytes48, SszError
+from ..ssz.composite import Container
+from ..ssz import boolean, uint64
+from ..ops.sha256 import hash64
+from .chain_spec import FAR_FUTURE_EPOCH
+
+# Packed wire layout: 121 bytes per record, field order per the spec
+# container (``consensus/types/src/validator.rs``).
+_VALIDATOR_DTYPE = np.dtype([
+    ("pubkey", "u1", (48,)),
+    ("withdrawal_credentials", "u1", (32,)),
+    ("effective_balance", "<u8"),
+    ("slashed", "u1"),
+    ("activation_eligibility_epoch", "<u8"),
+    ("activation_epoch", "<u8"),
+    ("exit_epoch", "<u8"),
+    ("withdrawable_epoch", "<u8"),
+])
+assert _VALIDATOR_DTYPE.itemsize == 121
+
+_EPOCH_FIELDS = ("activation_eligibility_epoch", "activation_epoch",
+                 "exit_epoch", "withdrawable_epoch")
+
+
+class Validator(Container):
+    """Single-record AoS form (interchange/SSZ boundary)."""
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    effective_balance: uint64
+    slashed: boolean
+    activation_eligibility_epoch: uint64
+    activation_epoch: uint64
+    exit_epoch: uint64
+    withdrawable_epoch: uint64
+
+
+def u64_to_chunk_words(v: np.ndarray) -> np.ndarray:
+    """``(n,) uint64`` → ``(n, 8) uint32`` big-endian words of the 32-byte
+    SSZ chunk (value little-endian, zero-padded)."""
+    v = np.asarray(v, dtype=np.uint64)
+    lo = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (v >> np.uint64(32)).astype(np.uint32)
+    out = np.zeros(v.shape + (8,), dtype=np.uint32)
+    out[..., 0] = lo.byteswap()
+    out[..., 1] = hi.byteswap()
+    return out
+
+
+def bytes_col_to_words(col: np.ndarray) -> np.ndarray:
+    """``(n, 4k) uint8`` → ``(n, k) uint32`` big-endian words."""
+    col = np.ascontiguousarray(col)
+    return col.view(">u4").astype(np.uint32)
+
+
+class ValidatorRegistry:
+    """SoA columns + list-like API.  Mutations go through the columns
+    (vectorized) or :meth:`set`; ``append`` amortizes with capacity doubling
+    like the reference's ``CacheArena`` grow path."""
+
+    __ssz_mutable__ = True
+
+    def __init__(self, n: int = 0, _cap: int | None = None):
+        cap = max(_cap if _cap is not None else n, n, 8)
+        self._n = n
+        self.pubkey = np.zeros((cap, 48), dtype=np.uint8)
+        self.withdrawal_credentials = np.zeros((cap, 32), dtype=np.uint8)
+        self.effective_balance = np.zeros(cap, dtype=np.uint64)
+        self.slashed = np.zeros(cap, dtype=bool)
+        self.activation_eligibility_epoch = np.full(
+            cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+        self.activation_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+        self.exit_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+        self.withdrawable_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+
+    _COLUMNS = ("pubkey", "withdrawal_credentials", "effective_balance",
+                "slashed", "activation_eligibility_epoch", "activation_epoch",
+                "exit_epoch", "withdrawable_epoch")
+
+    # -- list-like API -------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self._n
+
+    def col(self, name: str) -> np.ndarray:
+        """Live view of a column, truncated to the real length."""
+        return getattr(self, name)[:self._n]
+
+    def __getitem__(self, i: int) -> Validator:
+        if not -self._n <= i < self._n:
+            raise IndexError(i)
+        i %= max(self._n, 1)
+        return Validator(
+            pubkey=self.pubkey[i].tobytes(),
+            withdrawal_credentials=self.withdrawal_credentials[i].tobytes(),
+            effective_balance=int(self.effective_balance[i]),
+            slashed=bool(self.slashed[i]),
+            activation_eligibility_epoch=int(self.activation_eligibility_epoch[i]),
+            activation_epoch=int(self.activation_epoch[i]),
+            exit_epoch=int(self.exit_epoch[i]),
+            withdrawable_epoch=int(self.withdrawable_epoch[i]),
+        )
+
+    def __iter__(self):
+        for i in range(self._n):
+            yield self[i]
+
+    def set(self, i: int, v: Validator) -> None:
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        self.pubkey[i] = np.frombuffer(v.pubkey, dtype=np.uint8)
+        self.withdrawal_credentials[i] = np.frombuffer(
+            v.withdrawal_credentials, dtype=np.uint8)
+        self.effective_balance[i] = v.effective_balance
+        self.slashed[i] = v.slashed
+        self.activation_eligibility_epoch[i] = v.activation_eligibility_epoch
+        self.activation_epoch[i] = v.activation_epoch
+        self.exit_epoch[i] = v.exit_epoch
+        self.withdrawable_epoch[i] = v.withdrawable_epoch
+
+    def _grow(self, need: int) -> None:
+        cap = self.effective_balance.shape[0]
+        if need <= cap:
+            return
+        new_cap = max(need, cap * 2)
+        for name in self._COLUMNS:
+            old = getattr(self, name)
+            new = np.empty((new_cap,) + old.shape[1:], dtype=old.dtype)
+            new[:self._n] = old[:self._n]
+            if old.dtype == np.uint64 and name in _EPOCH_FIELDS:
+                new[self._n:] = FAR_FUTURE_EPOCH
+            else:
+                new[self._n:] = 0
+            setattr(self, name, new)
+
+    def append(self, v: Validator) -> None:
+        self._grow(self._n + 1)
+        self._n += 1
+        self.set(self._n - 1, v)
+
+    def copy(self) -> "ValidatorRegistry":
+        out = ValidatorRegistry.__new__(type(self))
+        out._n = self._n
+        for name in self._COLUMNS:
+            setattr(out, name, getattr(self, name)[:self._n].copy())
+        return out
+
+    def __eq__(self, other):
+        if not isinstance(other, ValidatorRegistry):
+            return NotImplemented
+        if self._n != other._n:
+            return False
+        return all(
+            np.array_equal(self.col(name), other.col(name))
+            for name in self._COLUMNS)
+
+    def __repr__(self):
+        return f"ValidatorRegistry(n={self._n})"
+
+    # -- bulk conversion -----------------------------------------------------
+
+    @classmethod
+    def from_validators(cls, validators) -> "ValidatorRegistry":
+        out = cls(len(validators))
+        out._n = len(validators)
+        for i, v in enumerate(validators):
+            out.set(i, v)
+        return out
+
+    def to_packed(self) -> bytes:
+        arr = np.empty(self._n, dtype=_VALIDATOR_DTYPE)
+        arr["pubkey"] = self.pubkey[:self._n]
+        arr["withdrawal_credentials"] = self.withdrawal_credentials[:self._n]
+        arr["effective_balance"] = self.effective_balance[:self._n]
+        arr["slashed"] = self.slashed[:self._n].astype(np.uint8)
+        for f in _EPOCH_FIELDS:
+            arr[f] = getattr(self, f)[:self._n]
+        return arr.tobytes()
+
+    @classmethod
+    def from_packed(cls, data: bytes) -> "ValidatorRegistry":
+        if len(data) % _VALIDATOR_DTYPE.itemsize:
+            raise SszError("validator registry bytes not a multiple of 121")
+        arr = np.frombuffer(data, dtype=_VALIDATOR_DTYPE)
+        n = arr.shape[0]
+        out = cls(n)
+        out._n = n
+        out.pubkey[:n] = arr["pubkey"]
+        out.withdrawal_credentials[:n] = arr["withdrawal_credentials"]
+        out.effective_balance[:n] = arr["effective_balance"]
+        if arr["slashed"].size and (arr["slashed"] > 1).any():
+            raise SszError("invalid boolean byte in validator record")
+        out.slashed[:n] = arr["slashed"].astype(bool)
+        for f in _EPOCH_FIELDS:
+            getattr(out, f)[:n] = arr[f]
+        return out
+
+    # -- Merkleization (the hot path) ---------------------------------------
+
+    def record_roots_words(self) -> np.ndarray:
+        """Per-validator hash_tree_roots as ``(n, 8)`` u32 words — one
+        batched device program (vs rayon-per-arena in the reference,
+        ``tree_hash_cache.rs:535-556``)."""
+        n = self._n
+        if n == 0:
+            return np.zeros((0, 8), dtype=np.uint32)
+        pk = self.pubkey[:n]
+        pk_hi = np.zeros((n, 32), dtype=np.uint8)
+        pk_hi[:, :16] = pk[:, 32:]
+        pubkey_root = hash64(bytes_col_to_words(pk[:, :32]),
+                             bytes_col_to_words(pk_hi))
+        leaves = np.stack([
+            np.asarray(pubkey_root),
+            bytes_col_to_words(self.withdrawal_credentials[:n]),
+            u64_to_chunk_words(self.effective_balance[:n]),
+            u64_to_chunk_words(self.slashed[:n].astype(np.uint64)),
+            u64_to_chunk_words(self.activation_eligibility_epoch[:n]),
+            u64_to_chunk_words(self.activation_epoch[:n]),
+            u64_to_chunk_words(self.exit_epoch[:n]),
+            u64_to_chunk_words(self.withdrawable_epoch[:n]),
+        ], axis=1)  # (n, 8, 8)
+        l1 = hash64(leaves[:, 0::2], leaves[:, 1::2])   # (n, 4, 8)
+        l2 = hash64(l1[:, 0::2], l1[:, 1::2])           # (n, 2, 8)
+        l3 = hash64(l2[:, 0], l2[:, 1])                 # (n, 8)
+        return np.asarray(l3)
+
+    def hash_tree_root(self, limit: int) -> bytes:
+        """Registry root: batched record roots → padded device reduction to
+        the ``limit``-leaf tree → length mixin."""
+        from .columns import device_merkle_root
+        return device_merkle_root(self.record_roots_words(), limit,
+                                  length_mixin=self._n)
+
+
+_registry_type_cache: dict[int, type] = {}
+
+
+def ValidatorRegistryList(limit: int) -> type:
+    """SSZ type for ``List[Validator, limit]`` backed by the SoA registry."""
+    cls = _registry_type_cache.get(limit)
+    if cls is not None:
+        return cls
+
+    from ..ssz.core import SszType
+
+    class _RegistryList(SszType):
+        ELEM = Validator
+        LIMIT = limit
+
+        @classmethod
+        def is_fixed_size(cls) -> bool:
+            return False
+
+        @classmethod
+        def serialize(cls, value) -> bytes:
+            if isinstance(value, ValidatorRegistry):
+                if len(value) > cls.LIMIT:
+                    raise SszError("validator registry exceeds limit")
+                return value.to_packed()
+            return ValidatorRegistry.from_validators(value).to_packed()
+
+        @classmethod
+        def deserialize(cls, data: bytes) -> ValidatorRegistry:
+            out = ValidatorRegistry.from_packed(data)
+            if len(out) > cls.LIMIT:
+                raise SszError("validator registry exceeds limit")
+            return out
+
+        @classmethod
+        def hash_tree_root(cls, value) -> bytes:
+            if not isinstance(value, ValidatorRegistry):
+                value = ValidatorRegistry.from_validators(value)
+            return value.hash_tree_root(cls.LIMIT)
+
+        @classmethod
+        def default(cls) -> ValidatorRegistry:
+            return ValidatorRegistry()
+
+    _RegistryList.__name__ = f"ValidatorRegistryList[{limit}]"
+    _registry_type_cache[limit] = _RegistryList
+    return _RegistryList

@@ -1,0 +1,244 @@
+"""Consensus-types tests.
+
+The columnar/SoA representations (registry, roots, packed uints) must be
+wire- and root-identical to the generic SSZ forms — the same parity bar the
+reference holds its ``cached_tree_hash`` to (cache root == uncached root,
+``/root/reference/consensus/cached_tree_hash/src/test.rs``).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ssz import Container, List, Vector, Bytes32, uint8, uint64
+from lighthouse_tpu.types import MAINNET, MINIMAL, ChainSpec, ForkName, spec_types
+from lighthouse_tpu.types.chain_spec import FAR_FUTURE_EPOCH
+from lighthouse_tpu.types.columns import (
+    PackedU8List,
+    PackedU64List,
+    PackedU64Vector,
+    Roots,
+    RootsList,
+    RootsVector,
+)
+from lighthouse_tpu.types.validators import (
+    Validator,
+    ValidatorRegistry,
+    ValidatorRegistryList,
+)
+
+T = spec_types(MINIMAL)
+
+
+def rand_roots(rng, n):
+    return rng.integers(0, 256, size=(n, 32), dtype=np.uint8).view(Roots)
+
+
+# ---------------------------------------------------------------------------
+# Columnar types == generic SSZ types
+# ---------------------------------------------------------------------------
+
+def test_roots_vector_matches_generic():
+    rng = np.random.default_rng(1)
+    n = 64
+    roots = rand_roots(rng, n)
+    RV, GV = RootsVector(n), Vector(Bytes32, n)
+    as_list = [roots.get(i) for i in range(n)]
+    assert RV.serialize(roots) == GV.serialize(as_list)
+    assert RV.hash_tree_root(roots) == GV.hash_tree_root(as_list)
+    back = RV.deserialize(RV.serialize(roots))
+    assert np.array_equal(back, roots)
+
+
+def test_roots_list_matches_generic():
+    rng = np.random.default_rng(2)
+    RL, GL = RootsList(2**24), List(Bytes32, 2**24)
+    for n in (0, 1, 5):
+        roots = rand_roots(rng, n)
+        as_list = [roots.get(i) for i in range(n)]
+        assert RL.hash_tree_root(roots) == GL.hash_tree_root(as_list)
+
+
+def test_packed_u64_matches_generic():
+    rng = np.random.default_rng(3)
+    vals = rng.integers(0, 2**63, size=37, dtype=np.uint64)
+    PL, GL = PackedU64List(2**40), List(uint64, 2**40)
+    assert PL.serialize(vals) == GL.serialize(vals)
+    assert PL.hash_tree_root(vals) == GL.hash_tree_root(vals)
+    PV, GV = PackedU64Vector(64), Vector(uint64, 64)
+    vec = rng.integers(0, 2**63, size=64, dtype=np.uint64)
+    assert PV.hash_tree_root(vec) == GV.hash_tree_root(vec)
+
+
+def test_packed_u8_matches_generic():
+    rng = np.random.default_rng(4)
+    vals = rng.integers(0, 8, size=100, dtype=np.uint8)
+    PL, GL = PackedU8List(2**40), List(uint8, 2**40)
+    assert PL.serialize(vals) == GL.serialize(vals)
+    assert PL.hash_tree_root(vals) == GL.hash_tree_root(vals)
+
+
+def make_validator(rng, **over):
+    kw = dict(
+        pubkey=bytes(rng.integers(0, 256, 48, dtype=np.uint8)),
+        withdrawal_credentials=bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+        effective_balance=int(rng.integers(1, 32) * 10**9),
+        slashed=bool(rng.integers(0, 2)),
+        activation_eligibility_epoch=int(rng.integers(0, 100)),
+        activation_epoch=int(rng.integers(0, 100)),
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+    kw.update(over)
+    return Validator(**kw)
+
+
+def test_registry_matches_generic_list():
+    rng = np.random.default_rng(5)
+    vals = [make_validator(rng) for _ in range(9)]
+    reg = ValidatorRegistry.from_validators(vals)
+    RT = ValidatorRegistryList(2**40)
+    GT = List(Validator, 2**40)
+    assert RT.serialize(reg) == GT.serialize(vals)
+    assert RT.hash_tree_root(reg) == GT.hash_tree_root(vals)
+    back = RT.deserialize(RT.serialize(reg))
+    assert back == reg
+    assert back[3] == vals[3]
+
+
+def test_registry_empty_root():
+    RT = ValidatorRegistryList(2**40)
+    GT = List(Validator, 2**40)
+    assert RT.hash_tree_root(ValidatorRegistry()) == GT.hash_tree_root([])
+
+
+def test_registry_append_and_mutate():
+    rng = np.random.default_rng(6)
+    reg = ValidatorRegistry()
+    for _ in range(20):
+        reg.append(make_validator(rng))
+    assert len(reg) == 20
+    reg.col("effective_balance")[:] = 31 * 10**9
+    assert reg[7].effective_balance == 31 * 10**9
+    cp = reg.copy()
+    cp.col("effective_balance")[0] = 1
+    assert reg[0].effective_balance == 31 * 10**9
+
+
+# ---------------------------------------------------------------------------
+# Spec types
+# ---------------------------------------------------------------------------
+
+def test_default_state_roundtrip_all_forks():
+    for fork in ForkName:
+        scls = T.state_cls(fork)
+        st = scls()
+        data = st.encode()
+        back = scls.deserialize(data)
+        assert back == st, fork
+        assert len(st.tree_hash_root()) == 32
+
+
+def test_state_field_count_per_fork():
+    # phase0: 21 fields; altair: 24 (participation swap + 3 new); bellatrix:
+    # 25; capella: 28 — matching consensus-specs containers.
+    assert len(T.state_cls(ForkName.PHASE0).FIELDS) == 21
+    assert len(T.state_cls(ForkName.ALTAIR).FIELDS) == 24
+    assert len(T.state_cls(ForkName.BELLATRIX).FIELDS) == 25
+    assert len(T.state_cls(ForkName.CAPELLA).FIELDS) == 28
+
+
+def test_state_common_prefix_field_order():
+    names = list(T.state_cls(ForkName.CAPELLA).FIELDS)
+    assert names[:4] == ["genesis_time", "genesis_validators_root", "slot",
+                         "fork"]
+    assert names[11:15] == ["validators", "balances", "randao_mixes",
+                            "slashings"]
+    assert names[-3:] == ["next_withdrawal_index",
+                          "next_withdrawal_validator_index",
+                          "historical_summaries"]
+    # capella swaps the payload-header type in place (superstruct-style)
+    i = names.index("latest_execution_payload_header")
+    assert i == 24
+
+
+def test_default_block_roundtrip_all_forks():
+    for fork in ForkName:
+        bcls = T.signed_block_cls(fork)
+        b = bcls()
+        assert bcls.deserialize(b.encode()) == b
+
+
+def test_attestation_roundtrip():
+    att = T.Attestation(
+        aggregation_bits=np.array([1, 0, 1, 1], dtype=bool),
+        data=T.AttestationData(slot=5, index=1),
+        signature=b"\x11" * 96,
+    )
+    back = T.Attestation.deserialize(att.encode())
+    assert back == att
+
+
+def test_fork_of_state_and_block():
+    st = T.state_cls(ForkName.CAPELLA)()
+    assert T.fork_of_state(st) == ForkName.CAPELLA
+    blk = T.block_cls(ForkName.ALTAIR)()
+    assert T.fork_of_block(blk) == ForkName.ALTAIR
+
+
+def test_mainnet_types_distinct_from_minimal():
+    TM = spec_types(MAINNET)
+    assert TM.SyncCommittee is not T.SyncCommittee
+    assert TM.preset.SYNC_COMMITTEE_SIZE == 512
+    assert T.preset.SYNC_COMMITTEE_SIZE == 32
+
+
+# ---------------------------------------------------------------------------
+# ChainSpec
+# ---------------------------------------------------------------------------
+
+def test_fork_schedule():
+    spec = ChainSpec.mainnet()
+    assert spec.fork_name_at_epoch(0) == ForkName.PHASE0
+    assert spec.fork_name_at_epoch(74240) == ForkName.ALTAIR
+    assert spec.fork_name_at_epoch(200000) == ForkName.CAPELLA
+    assert ForkName.CAPELLA > ForkName.BELLATRIX
+
+
+def test_with_forks_at_genesis():
+    spec = ChainSpec.minimal().with_forks_at_genesis(ForkName.CAPELLA)
+    assert spec.fork_name_at_epoch(0) == ForkName.CAPELLA
+
+
+def test_state_copy_isolates_registry():
+    st = T.state_cls(ForkName.CAPELLA)()
+    rng = np.random.default_rng(8)
+    st.validators.append(make_validator(rng))
+    st.balances = np.array([32 * 10**9], dtype=np.uint64)
+    cp = st.copy()
+    cp.validators.col("effective_balance")[0] = 7
+    cp.balances[0] = 7
+    assert st.validators[0].effective_balance != 7
+    assert st.balances[0] == 32 * 10**9
+
+
+# ---------------------------------------------------------------------------
+# Regression: review findings
+# ---------------------------------------------------------------------------
+
+def test_packed_vector_rejects_empty_and_2d():
+    from lighthouse_tpu.ssz import SszError
+    PV = PackedU64Vector(64)
+    with pytest.raises(SszError):
+        PV.serialize([])
+    with pytest.raises(SszError):
+        PV.deserialize(b"")
+    with pytest.raises(SszError):
+        PackedU64List(100).serialize(np.zeros((3, 2), dtype=np.uint64))
+
+
+def test_registry_limit1_root_matches_generic():
+    rng = np.random.default_rng(9)
+    v = make_validator(rng)
+    reg = ValidatorRegistry.from_validators([v])
+    assert ValidatorRegistryList(1).hash_tree_root(reg) \
+        == List(Validator, 1).hash_tree_root([v])
