@@ -1,0 +1,582 @@
+"""Block processing: header → withdrawals/payload → randao → eth1 data →
+operations → sync aggregate.
+
+Counterpart of ``/root/reference/consensus/state_processing/src/
+per_block_processing.rs:95-181`` and ``per_block_processing/
+{process_operations,verify_*}.rs``.  Signature handling mirrors
+``BlockSignatureStrategy`` (``per_block_processing.rs:49-58``): the caller
+picks no-verification / individual / bulk; bulk accumulates every set and
+verifies once via the BLS backend (one batched device dispatch).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+
+import numpy as np
+
+from ..crypto import bls as B
+from ..types.chain_spec import (
+    FAR_FUTURE_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    PROPOSER_WEIGHT,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    BLS_WITHDRAWAL_PREFIX,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    Domain,
+    ForkName,
+)
+from . import signature_sets as sigs
+from .committees import (
+    get_attesting_indices,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+)
+from .helpers import (
+    compute_epoch_at_slot,
+    current_epoch,
+    decrease_balance,
+    get_block_root,
+    get_block_root_at_slot,
+    get_randao_mix,
+    get_total_active_balance,
+    increase_balance,
+    previous_epoch,
+    sha,
+)
+from .mutations import initiate_validator_exit, slash_validator
+from .per_epoch import base_rewards_column, _full_column
+
+
+class BlockProcessingError(ValueError):
+    pass
+
+
+class SignatureStrategy(enum.Enum):
+    """``BlockSignatureStrategy`` (``per_block_processing.rs:49-58``)."""
+    NO_VERIFICATION = "no_verification"
+    VERIFY_INDIVIDUAL = "verify_individual"
+    VERIFY_BULK = "verify_bulk"
+    VERIFY_RANDAO = "verify_randao"
+
+
+class SigAccumulator:
+    """Collects signature sets; verifies at the end (bulk) or immediately
+    (individual) — the ``BlockSignatureVerifier`` accumulation pattern
+    (``block_signature_verifier.rs:74-214``)."""
+
+    def __init__(self, strategy: SignatureStrategy):
+        self.strategy = strategy
+        self.sets: list[B.SignatureSet] = []
+
+    def add(self, sset: B.SignatureSet | None) -> None:
+        if sset is None:
+            return
+        if self.strategy == SignatureStrategy.NO_VERIFICATION:
+            return
+        if self.strategy == SignatureStrategy.VERIFY_INDIVIDUAL:
+            if not B.verify_signature_sets([sset]):
+                raise BlockProcessingError("invalid signature")
+            return
+        self.sets.append(sset)
+
+    def finish(self) -> None:
+        if self.strategy == SignatureStrategy.VERIFY_BULK and self.sets:
+            if not B.verify_signature_sets(self.sets):
+                raise BlockProcessingError("bulk signature verification failed")
+
+
+def process_block(state, signed_block, fork: ForkName, preset, spec, T,
+                  strategy: SignatureStrategy = SignatureStrategy.VERIFY_BULK,
+                  pubkey_cache: sigs.PubkeyCache | None = None,
+                  verify_block_root: bytes | None = None,
+                  payload_verifier=None) -> None:
+    """Apply ``signed_block.message`` to ``state`` (already slot-advanced)."""
+    if pubkey_cache is None:
+        pubkey_cache = sigs.PubkeyCache()
+    acc = SigAccumulator(strategy)
+    block = signed_block.message
+
+    if strategy in (SignatureStrategy.VERIFY_INDIVIDUAL,
+                    SignatureStrategy.VERIFY_BULK):
+        acc.add(sigs.block_proposal_signature_set(
+            state, signed_block, pubkey_cache, preset,
+            block_root=verify_block_root))
+
+    process_block_header(state, block, preset, T)
+    if fork >= ForkName.CAPELLA:
+        process_withdrawals(state, block.body.execution_payload, preset, T)
+    if fork >= ForkName.BELLATRIX:
+        process_execution_payload(state, block.body, fork, preset, spec, T,
+                                  payload_verifier)
+    process_randao(state, block, preset, acc, pubkey_cache,
+                   verify=strategy != SignatureStrategy.NO_VERIFICATION)
+    process_eth1_data(state, block.body.eth1_data, preset)
+    process_operations(state, block.body, fork, preset, spec, T, acc,
+                       pubkey_cache)
+    if fork >= ForkName.ALTAIR:
+        process_sync_aggregate(state, block.body.sync_aggregate, preset, spec,
+                               T, acc)
+    acc.finish()
+
+
+def process_block_header(state, block, preset, T) -> None:
+    if block.slot != state.slot:
+        raise BlockProcessingError(
+            f"block slot {block.slot} != state slot {state.slot}")
+    if block.slot <= state.latest_block_header.slot:
+        raise BlockProcessingError("block not newer than latest header")
+    if block.proposer_index != get_beacon_proposer_index(state, preset):
+        raise BlockProcessingError("incorrect proposer index")
+    if block.parent_root != state.latest_block_header.tree_hash_root():
+        raise BlockProcessingError("parent root mismatch")
+    state.latest_block_header = T.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=b"\x00" * 32,
+        body_root=block.body.tree_hash_root(),
+    )
+    if bool(state.validators.col("slashed")[block.proposer_index]):
+        raise BlockProcessingError("proposer is slashed")
+
+
+def process_randao(state, block, preset, acc, pubkey_cache,
+                   verify: bool = True) -> None:
+    if verify:
+        acc.add(sigs.randao_signature_set(state, block, pubkey_cache, preset))
+    epoch = current_epoch(state, preset)
+    mix = bytes(a ^ b for a, b in zip(
+        get_randao_mix(state, epoch, preset), sha(block.body.randao_reveal)))
+    state.randao_mixes.set(epoch % preset.EPOCHS_PER_HISTORICAL_VECTOR, mix)
+
+
+def process_eth1_data(state, eth1_data, preset) -> None:
+    state.eth1_data_votes = list(state.eth1_data_votes) + [eth1_data]
+    votes_needed = preset.EPOCHS_PER_ETH1_VOTING_PERIOD * preset.SLOTS_PER_EPOCH
+    if sum(1 for v in state.eth1_data_votes if v == eth1_data) * 2 > votes_needed:
+        state.eth1_data = eth1_data
+
+
+# ---------------------------------------------------------------------------
+# Operations
+# ---------------------------------------------------------------------------
+
+def process_operations(state, body, fork, preset, spec, T, acc,
+                       pubkey_cache) -> None:
+    expected_deposits = min(
+        preset.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index)
+    if len(body.deposits) != expected_deposits:
+        raise BlockProcessingError(
+            f"expected {expected_deposits} deposits, block has "
+            f"{len(body.deposits)}")
+    for op in body.proposer_slashings:
+        process_proposer_slashing(state, op, fork, preset, spec, acc,
+                                  pubkey_cache)
+    for op in body.attester_slashings:
+        process_attester_slashing(state, op, fork, preset, spec, acc,
+                                  pubkey_cache)
+    for op in body.attestations:
+        process_attestation(state, op, fork, preset, spec, acc, pubkey_cache)
+    for op in body.deposits:
+        process_deposit(state, op, preset, spec, T)
+    for op in body.voluntary_exits:
+        process_voluntary_exit(state, op, fork, preset, spec, acc,
+                               pubkey_cache)
+    if fork >= ForkName.CAPELLA:
+        for op in body.bls_to_execution_changes:
+            process_bls_to_execution_change(state, op, spec, acc)
+
+
+def process_proposer_slashing(state, slashing, fork, preset, spec, acc,
+                              pubkey_cache) -> None:
+    h1, h2 = slashing.signed_header_1.message, slashing.signed_header_2.message
+    if h1.slot != h2.slot:
+        raise BlockProcessingError("proposer slashing: slot mismatch")
+    if h1.proposer_index != h2.proposer_index:
+        raise BlockProcessingError("proposer slashing: proposer mismatch")
+    if h1 == h2:
+        raise BlockProcessingError("proposer slashing: identical headers")
+    idx = h1.proposer_index
+    epoch = current_epoch(state, preset)
+    from .helpers import is_slashable_at
+    if not bool(is_slashable_at(state.validators, epoch)[idx]):
+        raise BlockProcessingError("proposer not slashable")
+    for sh in (slashing.signed_header_1, slashing.signed_header_2):
+        acc.add(sigs.block_header_signature_set(state, sh, pubkey_cache,
+                                                preset))
+    slash_validator(state, idx, fork, preset, spec)
+
+
+def is_valid_indexed_attestation(state, indexed, preset, acc,
+                                 pubkey_cache) -> None:
+    indices = list(indexed.attesting_indices)
+    if not indices:
+        raise BlockProcessingError("indexed attestation: empty indices")
+    if indices != sorted(set(indices)):
+        raise BlockProcessingError("indexed attestation: not sorted/unique")
+    if max(indices) >= len(state.validators):
+        raise BlockProcessingError("indexed attestation: unknown validator")
+    acc.add(sigs.indexed_attestation_signature_set(
+        state, indices, indexed.signature, indexed.data, pubkey_cache,
+        preset))
+
+
+def is_slashable_attestation_data(d1, d2) -> bool:
+    double = d1 != d2 and d1.target.epoch == d2.target.epoch
+    surround = (d1.source.epoch < d2.source.epoch
+                and d2.target.epoch < d1.target.epoch)
+    return double or surround
+
+
+def process_attester_slashing(state, slashing, fork, preset, spec, acc,
+                              pubkey_cache) -> None:
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise BlockProcessingError("attestations not slashable")
+    is_valid_indexed_attestation(state, a1, preset, acc, pubkey_cache)
+    is_valid_indexed_attestation(state, a2, preset, acc, pubkey_cache)
+    from .helpers import is_slashable_at
+    epoch = current_epoch(state, preset)
+    slashable = is_slashable_at(state.validators, epoch)
+    common = sorted(set(map(int, a1.attesting_indices))
+                    & set(map(int, a2.attesting_indices)))
+    slashed_any = False
+    for idx in common:
+        if bool(slashable[idx]):
+            slash_validator(state, idx, fork, preset, spec)
+            slashed_any = True
+    if not slashed_any:
+        raise BlockProcessingError("no slashable indices")
+
+
+def get_attestation_participation_flag_indices(state, data, inclusion_delay,
+                                               preset) -> list[int]:
+    """Spec altair helper: which timeliness flags this attestation earns."""
+    if data.target.epoch == current_epoch(state, preset):
+        justified = state.current_justified_checkpoint
+    else:
+        justified = state.previous_justified_checkpoint
+    if data.source != justified:
+        raise BlockProcessingError("attestation source != justified checkpoint")
+    is_matching_target = data.target.root == get_block_root(
+        state, data.target.epoch, preset)
+    is_matching_head = (is_matching_target and data.beacon_block_root
+                        == get_block_root_at_slot(state, data.slot, preset))
+    flags = []
+    if inclusion_delay <= math.isqrt(preset.SLOTS_PER_EPOCH):
+        flags.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= preset.SLOTS_PER_EPOCH:
+        flags.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == preset.MIN_ATTESTATION_INCLUSION_DELAY:
+        flags.append(TIMELY_HEAD_FLAG_INDEX)
+    return flags
+
+
+def process_attestation(state, attestation, fork, preset, spec, acc,
+                        pubkey_cache) -> None:
+    data = attestation.data
+    cur, prev = current_epoch(state, preset), previous_epoch(state, preset)
+    if data.target.epoch not in (prev, cur):
+        raise BlockProcessingError("attestation target epoch out of range")
+    if data.target.epoch != compute_epoch_at_slot(data.slot,
+                                                  preset.SLOTS_PER_EPOCH):
+        raise BlockProcessingError("target epoch != epoch of slot")
+    if not (data.slot + preset.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+            <= data.slot + preset.SLOTS_PER_EPOCH):
+        raise BlockProcessingError("attestation outside inclusion window")
+    if data.index >= get_committee_count_per_slot(state, data.target.epoch,
+                                                  preset):
+        raise BlockProcessingError("committee index out of range")
+
+    indices = get_attesting_indices(state, data, attestation.aggregation_bits,
+                                    preset)
+    acc.add(sigs.indexed_attestation_signature_set(
+        state, indices, attestation.signature, data, pubkey_cache, preset))
+
+    inclusion_delay = state.slot - data.slot
+    flags = get_attestation_participation_flag_indices(
+        state, data, inclusion_delay, preset)
+
+    if data.target.epoch == cur:
+        participation = state.current_epoch_participation
+    else:
+        participation = state.previous_epoch_participation
+    n = len(state.validators)
+    participation = _full_column(participation, n, np.uint8)
+
+    total = get_total_active_balance(state, preset)
+    base = base_rewards_column(state, total, preset)
+    idx = indices.astype(np.int64)
+    proposer_reward_numerator = 0
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        if flag_index not in flags:
+            continue
+        bit = np.uint8(1 << flag_index)
+        fresh = (participation[idx] & bit) == 0
+        participation[idx] |= bit
+        proposer_reward_numerator += int(base[idx[fresh]].sum()) * weight
+
+    if data.target.epoch == cur:
+        state.current_epoch_participation = participation
+    else:
+        state.previous_epoch_participation = participation
+
+    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    increase_balance(state, get_beacon_proposer_index(state, preset),
+                     proposer_reward)
+
+
+def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int,
+                           root: bytes) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = sha(branch[i] + value)
+        else:
+            value = sha(value + branch[i])
+    return value == root
+
+
+def process_deposit(state, deposit, preset, spec, T) -> None:
+    leaf = deposit.data.tree_hash_root()
+    if not is_valid_merkle_branch(
+            leaf, deposit.proof, preset.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            state.eth1_deposit_index, state.eth1_data.deposit_root):
+        raise BlockProcessingError("invalid deposit merkle proof")
+    state.eth1_deposit_index += 1
+    apply_deposit(state, deposit.data, preset, spec, T)
+
+
+def apply_deposit(state, data, preset, spec, T) -> None:
+    cache = _state_pubkey_cache(state)
+    index = cache.index_of(state.validators, data.pubkey)
+    if index is not None:
+        increase_balance(state, index, data.amount)
+        return
+    # New validator: verify the deposit signature; invalid => skip silently
+    # (spec behaviour — bad deposits burn the ETH).
+    sset = sigs.deposit_signature_set(data, T, spec.genesis_fork_version)
+    try:
+        if not B.verify_signature_sets([sset]):
+            return
+    except B.BlsError:
+        return
+    from ..types.validators import Validator
+    amount = data.amount
+    eff = min(amount - amount % preset.EFFECTIVE_BALANCE_INCREMENT,
+              preset.MAX_EFFECTIVE_BALANCE)
+    state.validators.append(Validator(
+        pubkey=data.pubkey,
+        withdrawal_credentials=data.withdrawal_credentials,
+        effective_balance=eff,
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    ))
+    state.balances = np.concatenate(
+        [np.asarray(state.balances, dtype=np.uint64),
+         np.array([amount], dtype=np.uint64)])
+    if hasattr(state, "previous_epoch_participation"):
+        n = len(state.validators)
+        state.previous_epoch_participation = _full_column(
+            state.previous_epoch_participation, n, np.uint8)
+        state.current_epoch_participation = _full_column(
+            state.current_epoch_participation, n, np.uint8)
+        state.inactivity_scores = _full_column(
+            state.inactivity_scores, n, np.uint64)
+
+
+def process_voluntary_exit(state, signed_exit, fork, preset, spec, acc,
+                           pubkey_cache) -> None:
+    exit = signed_exit.message
+    idx = exit.validator_index
+    reg = state.validators
+    epoch = current_epoch(state, preset)
+    if idx >= len(reg):
+        raise BlockProcessingError("exit: unknown validator")
+    from .helpers import is_active_at
+    if not bool(is_active_at(reg, epoch)[idx]):
+        raise BlockProcessingError("exit: validator not active")
+    if int(reg.col("exit_epoch")[idx]) != FAR_FUTURE_EPOCH:
+        raise BlockProcessingError("exit: already exiting")
+    if epoch < exit.epoch:
+        raise BlockProcessingError("exit: not yet valid")
+    if epoch < int(reg.col("activation_epoch")[idx]) + spec.shard_committee_period:
+        raise BlockProcessingError("exit: validator too young")
+    acc.add(sigs.voluntary_exit_signature_set(state, signed_exit,
+                                              pubkey_cache, preset))
+    initiate_validator_exit(state, idx, preset, spec)
+
+
+def process_bls_to_execution_change(state, signed_change, spec, acc) -> None:
+    change = signed_change.message
+    idx = change.validator_index
+    if idx >= len(state.validators):
+        raise BlockProcessingError("bls change: unknown validator")
+    creds = state.validators.col("withdrawal_credentials")[idx].tobytes()
+    if creds[:1] != BLS_WITHDRAWAL_PREFIX:
+        raise BlockProcessingError("bls change: not BLS credentials")
+    if creds[1:] != sha(change.from_bls_pubkey)[1:]:
+        raise BlockProcessingError("bls change: pubkey hash mismatch")
+    acc.add(sigs.bls_to_execution_change_signature_set(
+        state, signed_change, spec.genesis_fork_version, None))
+    new = (ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11
+           + change.to_execution_address)
+    state.validators.col("withdrawal_credentials")[idx] = np.frombuffer(
+        new, dtype=np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Sync aggregate
+# ---------------------------------------------------------------------------
+
+def process_sync_aggregate(state, aggregate, preset, spec, T, acc) -> None:
+    def block_root_fn(slot):
+        return get_block_root_at_slot(state, slot, preset)
+
+    acc.add(sigs.sync_aggregate_signature_set(
+        state, aggregate, state.slot, block_root_fn, preset))
+
+    total = get_total_active_balance(state, preset)
+    from .per_epoch import base_reward_per_increment
+    per_inc = base_reward_per_increment(total, preset)
+    total_increments = total // preset.EFFECTIVE_BALANCE_INCREMENT
+    total_base_rewards = per_inc * total_increments
+    max_participant_rewards = (total_base_rewards * 2 // WEIGHT_DENOMINATOR
+                               // preset.SLOTS_PER_EPOCH)
+    participant_reward = max_participant_rewards // preset.SYNC_COMMITTEE_SIZE
+    proposer_reward = (participant_reward * PROPOSER_WEIGHT
+                       // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+
+    cache = _state_pubkey_cache(state)
+    proposer = get_beacon_proposer_index(state, preset)
+    bits = np.asarray(aggregate.sync_committee_bits, dtype=bool)
+    for i, pk in enumerate(state.current_sync_committee.pubkeys):
+        idx = cache.index_of(state.validators, pk)
+        if idx is None:
+            raise BlockProcessingError("sync committee pubkey not in registry")
+        if bits[i]:
+            increase_balance(state, idx, participant_reward)
+            increase_balance(state, proposer, proposer_reward)
+        else:
+            decrease_balance(state, idx, participant_reward)
+
+
+# ---------------------------------------------------------------------------
+# Execution payload + withdrawals (bellatrix / capella)
+# ---------------------------------------------------------------------------
+
+def is_merge_transition_complete(state) -> bool:
+    header = state.latest_execution_payload_header
+    return type(header)().tree_hash_root() != header.tree_hash_root()
+
+
+def compute_timestamp_at_slot(state, spec, preset) -> int:
+    return state.genesis_time + state.slot * spec.seconds_per_slot
+
+
+def process_execution_payload(state, body, fork, preset, spec, T,
+                              payload_verifier=None) -> None:
+    payload = body.execution_payload
+    if is_merge_transition_complete(state):
+        if payload.parent_hash != state.latest_execution_payload_header.block_hash:
+            raise BlockProcessingError("payload parent hash mismatch")
+    if payload.prev_randao != get_randao_mix(
+            state, current_epoch(state, preset), preset):
+        raise BlockProcessingError("payload prev_randao mismatch")
+    if payload.timestamp != compute_timestamp_at_slot(state, spec, preset):
+        raise BlockProcessingError("payload timestamp mismatch")
+    if payload_verifier is not None:
+        payload_verifier(payload)  # engine-API newPayload seam
+
+    header_cls = type(state).FIELDS["latest_execution_payload_header"]
+    tx_list_t = type(payload).FIELDS["transactions"]
+    kw = dict(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=tx_list_t.hash_tree_root(payload.transactions),
+    )
+    if fork >= ForkName.CAPELLA:
+        wd_list_t = type(payload).FIELDS["withdrawals"]
+        kw["withdrawals_root"] = wd_list_t.hash_tree_root(payload.withdrawals)
+    state.latest_execution_payload_header = header_cls(**kw)
+
+
+def get_expected_withdrawals(state, preset) -> list:
+    """Capella withdrawal sweep (spec ``get_expected_withdrawals``)."""
+    epoch = current_epoch(state, preset)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    reg = state.validators
+    n = len(reg)
+    withdrawals = []
+    creds = reg.col("withdrawal_credentials")
+    for _ in range(min(n, preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        if len(withdrawals) == preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        balance = int(state.balances[validator_index]) \
+            if validator_index < state.balances.shape[0] else 0
+        cred = creds[validator_index].tobytes()
+        has_eth1 = cred[:1] == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+        wd_epoch = int(reg.col("withdrawable_epoch")[validator_index])
+        eff = int(reg.col("effective_balance")[validator_index])
+        if has_eth1 and wd_epoch <= epoch and balance > 0:
+            withdrawals.append((withdrawal_index, validator_index,
+                                cred[12:], balance))
+            withdrawal_index += 1
+        elif (has_eth1 and eff == preset.MAX_EFFECTIVE_BALANCE
+              and balance > preset.MAX_EFFECTIVE_BALANCE):
+            withdrawals.append((withdrawal_index, validator_index, cred[12:],
+                                balance - preset.MAX_EFFECTIVE_BALANCE))
+            withdrawal_index += 1
+        validator_index = (validator_index + 1) % n
+    return withdrawals
+
+
+def process_withdrawals(state, payload, preset, T) -> None:
+    expected = get_expected_withdrawals(state, preset)
+    got = [(w.index, w.validator_index, w.address, w.amount)
+           for w in payload.withdrawals]
+    if got != expected:
+        raise BlockProcessingError("withdrawals mismatch")
+    for (_, vidx, _, amount) in expected:
+        decrease_balance(state, vidx, amount)
+    if expected:
+        state.next_withdrawal_index = expected[-1][0] + 1
+    n = len(state.validators)
+    if len(expected) == preset.MAX_WITHDRAWALS_PER_PAYLOAD:
+        state.next_withdrawal_validator_index = \
+            (expected[-1][1] + 1) % n
+    else:
+        state.next_withdrawal_validator_index = (
+            state.next_withdrawal_validator_index
+            + preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP) % n
+
+
+def _state_pubkey_cache(state) -> sigs.PubkeyCache:
+    cache = getattr(state, "_pubkey_cache", None)
+    if cache is None:
+        cache = sigs.PubkeyCache()
+        state._pubkey_cache = cache
+    return cache

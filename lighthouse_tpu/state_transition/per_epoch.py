@@ -1,0 +1,431 @@
+"""Epoch processing (altair through capella), vectorized over SoA columns.
+
+Counterpart of ``/root/reference/consensus/state_processing/src/
+per_epoch_processing/{altair,capella}/`` and the shared steps in
+``per_epoch_processing/*``.  Where the reference precomputes a
+``ParticipationCache`` then loops validators (with rayon), every step here
+is whole-column numpy arithmetic — the registry IS the batch.  The returned
+:class:`EpochSummary` plays the role of ``epoch_processing_summary.rs``
+(metrics/validator-monitor input).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..types.chain_spec import (
+    FAR_FUTURE_EPOCH,
+    GENESIS_EPOCH,
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+    Domain,
+    ForkName,
+)
+from .helpers import (
+    compute_activation_exit_epoch,
+    current_epoch,
+    get_block_root,
+    get_randao_mix,
+    get_total_active_balance,
+    has_flag,
+    is_active_at,
+    previous_epoch,
+    sha,
+)
+from .mutations import initiate_validator_exit, proportional_slashing_multiplier
+from .shuffle import compute_shuffled_index
+
+
+@dataclass
+class EpochSummary:
+    """Per-epoch numbers for metrics/monitoring
+    (``epoch_processing_summary.rs`` analogue)."""
+    total_active_balance: int = 0
+    previous_target_balance: int = 0
+    current_target_balance: int = 0
+    activated: int = 0
+    ejected: int = 0
+    rewards: np.ndarray | None = None
+    penalties: np.ndarray | None = None
+
+
+def base_reward_per_increment(total_active_balance: int, preset) -> int:
+    return (preset.EFFECTIVE_BALANCE_INCREMENT * preset.BASE_REWARD_FACTOR
+            // math.isqrt(total_active_balance))
+
+
+def base_rewards_column(state, total_active_balance: int, preset) -> np.ndarray:
+    """Vectorized spec ``get_base_reward`` for all validators."""
+    per_inc = base_reward_per_increment(total_active_balance, preset)
+    increments = state.validators.col("effective_balance") // np.uint64(
+        preset.EFFECTIVE_BALANCE_INCREMENT)
+    return increments * np.uint64(per_inc)
+
+
+def eligible_validator_mask(state, preset) -> np.ndarray:
+    """``get_eligible_validator_indices`` as a mask."""
+    reg = state.validators
+    prev = previous_epoch(state, preset)
+    return (is_active_at(reg, prev)
+            | (reg.col("slashed")
+               & (prev + 1 < reg.col("withdrawable_epoch"))))
+
+
+def unslashed_participating_mask(state, flag_index: int, epoch: int,
+                                 preset) -> np.ndarray:
+    """``get_unslashed_participating_indices`` as a mask."""
+    if epoch == current_epoch(state, preset):
+        participation = state.current_epoch_participation
+    elif epoch == previous_epoch(state, preset):
+        participation = state.previous_epoch_participation
+    else:
+        raise ValueError("epoch out of participation range")
+    n = len(state.validators)
+    part = np.zeros(n, dtype=np.uint8)
+    part[:participation.shape[0]] = participation
+    return (is_active_at(state.validators, epoch)
+            & has_flag(part, flag_index)
+            & ~state.validators.col("slashed"))
+
+
+def _participating_balance(state, mask: np.ndarray, preset) -> int:
+    bal = int(state.validators.col("effective_balance")[mask].sum())
+    return max(bal, preset.EFFECTIVE_BALANCE_INCREMENT)
+
+
+def is_in_inactivity_leak(state, preset) -> bool:
+    finality_delay = (previous_epoch(state, preset)
+                      - state.finalized_checkpoint.epoch)
+    return finality_delay > preset.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+# ---------------------------------------------------------------------------
+# Steps
+# ---------------------------------------------------------------------------
+
+def process_justification_and_finalization(state, preset, T,
+                                           summary: EpochSummary) -> None:
+    cur = current_epoch(state, preset)
+    if cur <= GENESIS_EPOCH + 1:
+        return
+    prev = previous_epoch(state, preset)
+    prev_target = _participating_balance(
+        state, unslashed_participating_mask(
+            state, TIMELY_TARGET_FLAG_INDEX, prev, preset), preset)
+    cur_target = _participating_balance(
+        state, unslashed_participating_mask(
+            state, TIMELY_TARGET_FLAG_INDEX, cur, preset), preset)
+    total = get_total_active_balance(state, preset)
+    summary.total_active_balance = total
+    summary.previous_target_balance = prev_target
+    summary.current_target_balance = cur_target
+    weigh_justification_and_finalization(state, total, prev_target,
+                                         cur_target, preset, T)
+
+
+def weigh_justification_and_finalization(state, total, prev_target, cur_target,
+                                         preset, T) -> None:
+    cur = current_epoch(state, preset)
+    prev = previous_epoch(state, preset)
+    old_prev_justified = state.previous_justified_checkpoint
+    old_cur_justified = state.current_justified_checkpoint
+
+    state.previous_justified_checkpoint = state.current_justified_checkpoint
+    bits = state.justification_bits
+    bits[1:] = bits[:-1].copy()
+    bits[0] = False
+    if prev_target * 3 >= total * 2:
+        state.current_justified_checkpoint = T.Checkpoint(
+            epoch=prev, root=get_block_root(state, prev, preset))
+        bits[1] = True
+    if cur_target * 3 >= total * 2:
+        state.current_justified_checkpoint = T.Checkpoint(
+            epoch=cur, root=get_block_root(state, cur, preset))
+        bits[0] = True
+
+    # Finalization (the four 2nd/234th-bit rules).
+    if bits[1:4].all() and old_prev_justified.epoch + 3 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if bits[1:3].all() and old_prev_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_prev_justified
+    if bits[0:3].all() and old_cur_justified.epoch + 2 == cur:
+        state.finalized_checkpoint = old_cur_justified
+    if bits[0:2].all() and old_cur_justified.epoch + 1 == cur:
+        state.finalized_checkpoint = old_cur_justified
+
+
+def process_inactivity_updates(state, preset, spec) -> None:
+    if current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    eligible = eligible_validator_mask(state, preset)
+    target = unslashed_participating_mask(
+        state, TIMELY_TARGET_FLAG_INDEX, previous_epoch(state, preset), preset)
+    scores = _full_column(state.inactivity_scores, len(state.validators),
+                          np.uint64)
+    # participating: score -= min(1, score); else score += bias
+    dec = np.minimum(np.uint64(1), scores)
+    scores = np.where(eligible & target, scores - dec, scores)
+    scores = np.where(eligible & ~target,
+                      scores + np.uint64(spec.inactivity_score_bias), scores)
+    if not is_in_inactivity_leak(state, preset):
+        rec = np.minimum(np.uint64(spec.inactivity_score_recovery_rate), scores)
+        scores = np.where(eligible, scores - rec, scores)
+    state.inactivity_scores = scores
+
+
+def _full_column(arr, n: int, dtype) -> np.ndarray:
+    out = np.zeros(n, dtype=dtype)
+    out[:arr.shape[0]] = arr
+    return out
+
+
+def inactivity_penalty_quotient(fork: ForkName, preset) -> int:
+    if fork >= ForkName.BELLATRIX:
+        return preset.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    if fork >= ForkName.ALTAIR:
+        return preset.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    return preset.INACTIVITY_PENALTY_QUOTIENT
+
+
+def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
+                                  summary: EpochSummary) -> None:
+    if current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    prev = previous_epoch(state, preset)
+    total = get_total_active_balance(state, preset)
+    eligible = eligible_validator_mask(state, preset)
+    base = base_rewards_column(state, total, preset)
+    active_increments = total // preset.EFFECTIVE_BALANCE_INCREMENT
+    in_leak = is_in_inactivity_leak(state, preset)
+
+    rewards = np.zeros(n, dtype=np.uint64)
+    penalties = np.zeros(n, dtype=np.uint64)
+
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = unslashed_participating_mask(
+            state, flag_index, prev, preset)
+        unslashed_increments = (
+            _participating_balance(state, participating, preset)
+            // preset.EFFECTIVE_BALANCE_INCREMENT)
+        if not in_leak:
+            reward_num = base * np.uint64(weight) * np.uint64(unslashed_increments)
+            rewards += np.where(
+                eligible & participating,
+                reward_num // np.uint64(active_increments * WEIGHT_DENOMINATOR),
+                np.uint64(0))
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties += np.where(
+                eligible & ~participating,
+                base * np.uint64(weight) // np.uint64(WEIGHT_DENOMINATOR),
+                np.uint64(0))
+
+    # Inactivity penalties (altair formula).
+    target = unslashed_participating_mask(
+        state, TIMELY_TARGET_FLAG_INDEX, prev, preset)
+    scores = _full_column(state.inactivity_scores, n, np.uint64)
+    quotient = (spec.inactivity_score_bias
+                * inactivity_penalty_quotient(fork, preset))
+    inact = (state.validators.col("effective_balance") * scores
+             // np.uint64(quotient))
+    penalties += np.where(eligible & ~target, inact, np.uint64(0))
+
+    summary.rewards, summary.penalties = rewards, penalties
+    bal = _full_column(state.balances, n, np.uint64)
+    bal = bal + rewards
+    bal = np.where(bal >= penalties, bal - penalties, np.uint64(0))
+    state.balances = bal
+
+
+def process_registry_updates(state, preset, spec,
+                             summary: EpochSummary) -> None:
+    reg = state.validators
+    cur = current_epoch(state, preset)
+
+    # Eligibility for the activation queue.
+    eligible = ((reg.col("activation_eligibility_epoch")
+                 == np.uint64(FAR_FUTURE_EPOCH))
+                & (reg.col("effective_balance")
+                   == np.uint64(preset.MAX_EFFECTIVE_BALANCE)))
+    reg.col("activation_eligibility_epoch")[eligible] = cur + 1
+
+    # Ejections — sequential: each consumes exit churn.
+    eject = (is_active_at(reg, cur)
+             & (reg.col("effective_balance")
+                <= np.uint64(spec.ejection_balance)))
+    for idx in np.flatnonzero(eject):
+        initiate_validator_exit(state, int(idx), preset, spec)
+        summary.ejected += 1
+
+    # Activation queue: ordered by (eligibility epoch, index), churn-limited.
+    queue_mask = ((reg.col("activation_eligibility_epoch")
+                   <= np.uint64(state.finalized_checkpoint.epoch))
+                  & (reg.col("activation_epoch")
+                     == np.uint64(FAR_FUTURE_EPOCH)))
+    queue = np.flatnonzero(queue_mask)
+    order = np.argsort(
+        reg.col("activation_eligibility_epoch")[queue], kind="stable")
+    queue = queue[order]
+    from .helpers import get_validator_churn_limit
+    churn = get_validator_churn_limit(state, preset, spec)
+    dequeued = queue[:churn]
+    reg.col("activation_epoch")[dequeued] = compute_activation_exit_epoch(
+        cur, preset.MAX_SEED_LOOKAHEAD)
+    summary.activated += len(dequeued)
+
+
+def process_slashings(state, fork: ForkName, preset) -> None:
+    cur = current_epoch(state, preset)
+    total = get_total_active_balance(state, preset)
+    adjusted = min(
+        int(state.slashings.sum()) * proportional_slashing_multiplier(fork, preset),
+        total)
+    reg = state.validators
+    inc = preset.EFFECTIVE_BALANCE_INCREMENT
+    mask = (reg.col("slashed")
+            & (cur + preset.EPOCHS_PER_SLASHINGS_VECTOR // 2
+               == reg.col("withdrawable_epoch")))
+    if not mask.any():
+        return
+    # Per-spec integer order: (eff // inc * adjusted) // total * inc.
+    # increments ≤ 32 and adjusted ≤ total_balance, so the product fits u64.
+    increments = reg.col("effective_balance") // np.uint64(inc)
+    penalties = (increments * np.uint64(adjusted)
+                 // np.uint64(total) * np.uint64(inc))
+    n = len(reg)
+    bal = _full_column(state.balances, n, np.uint64)
+    pen = np.where(mask, penalties, np.uint64(0))
+    state.balances = np.where(bal >= pen, bal - pen, np.uint64(0))
+
+
+def process_eth1_data_reset(state, preset) -> None:
+    next_epoch = current_epoch(state, preset) + 1
+    if next_epoch % preset.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(state, preset) -> None:
+    reg = state.validators
+    n = len(reg)
+    bal = _full_column(state.balances, n, np.uint64)
+    eff = reg.col("effective_balance")
+    inc = np.uint64(preset.EFFECTIVE_BALANCE_INCREMENT)
+    hysteresis_inc = inc // np.uint64(preset.HYSTERESIS_QUOTIENT)
+    downward = hysteresis_inc * np.uint64(preset.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    upward = hysteresis_inc * np.uint64(preset.HYSTERESIS_UPWARD_MULTIPLIER)
+    update = (bal + downward < eff) | (eff + upward < bal)
+    new_eff = np.minimum(bal - bal % inc,
+                         np.uint64(preset.MAX_EFFECTIVE_BALANCE))
+    reg.col("effective_balance")[update] = new_eff[update]
+
+
+def process_slashings_reset(state, preset) -> None:
+    next_epoch = current_epoch(state, preset) + 1
+    state.slashings[next_epoch % preset.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(state, preset) -> None:
+    cur = current_epoch(state, preset)
+    next_epoch = cur + 1
+    state.randao_mixes.set(next_epoch % preset.EPOCHS_PER_HISTORICAL_VECTOR,
+                           get_randao_mix(state, cur, preset))
+
+
+def process_historical_update(state, fork: ForkName, preset, T) -> None:
+    """historical_roots (pre-capella) / historical_summaries (capella+)."""
+    next_epoch = current_epoch(state, preset) + 1
+    if next_epoch % (preset.SLOTS_PER_HISTORICAL_ROOT
+                     // preset.SLOTS_PER_EPOCH) != 0:
+        return
+    if fork >= ForkName.CAPELLA:
+        state.historical_summaries = state.historical_summaries + [
+            T.HistoricalSummary(
+                block_summary_root=type(state).FIELDS["block_roots"]
+                .hash_tree_root(state.block_roots),
+                state_summary_root=type(state).FIELDS["state_roots"]
+                .hash_tree_root(state.state_roots),
+            )]
+    else:
+        batch = T.HistoricalBatch(block_roots=state.block_roots,
+                                  state_roots=state.state_roots)
+        state.historical_roots = state.historical_roots.append_root(
+            batch.tree_hash_root())
+
+
+def process_participation_flag_updates(state) -> None:
+    n = len(state.validators)
+    state.previous_epoch_participation = _full_column(
+        state.current_epoch_participation, n, np.uint8)
+    state.current_epoch_participation = np.zeros(n, dtype=np.uint8)
+
+
+def get_next_sync_committee_indices(state, preset) -> list[int]:
+    """Spec sampling: shuffled candidates + effective-balance acceptance."""
+    epoch = current_epoch(state, preset) + 1
+    from .helpers import get_active_validator_indices, get_seed
+    active = get_active_validator_indices(state.validators, epoch)
+    count = len(active)
+    seed = get_seed(state, epoch, Domain.SYNC_COMMITTEE, preset)
+    eff = state.validators.col("effective_balance")
+    out: list[int] = []
+    i = 0
+    while len(out) < preset.SYNC_COMMITTEE_SIZE:
+        shuffled = compute_shuffled_index(i % count, count, seed,
+                                          preset.SHUFFLE_ROUND_COUNT)
+        cand = int(active[shuffled])
+        random_byte = sha(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        if int(eff[cand]) * 255 >= preset.MAX_EFFECTIVE_BALANCE * random_byte:
+            out.append(cand)
+        i += 1
+    return out
+
+
+def get_next_sync_committee(state, preset, T):
+    from ..crypto import bls as B
+    from ..crypto import curve as C
+    indices = get_next_sync_committee_indices(state, preset)
+    pubkeys = [state.validators.col("pubkey")[i].tobytes() for i in indices]
+    agg = None
+    for pk in pubkeys:
+        agg = C.g1_add(agg, C.g1_decompress(pk))
+    return T.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=C.g1_compress(agg))
+
+
+def process_sync_committee_updates(state, preset, T) -> None:
+    next_epoch = current_epoch(state, preset) + 1
+    if next_epoch % preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(state, preset, T)
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def process_epoch(state, fork: ForkName, preset, spec, T) -> EpochSummary:
+    """Altair+ epoch transition, step order per
+    ``per_epoch_processing/altair.rs:process_epoch``."""
+    if fork == ForkName.PHASE0:
+        raise NotImplementedError(
+            "phase0 (PendingAttestation-based) epoch processing is not "
+            "implemented; start chains at altair or later")
+    summary = EpochSummary()
+    process_justification_and_finalization(state, preset, T, summary)
+    process_inactivity_updates(state, preset, spec)
+    process_rewards_and_penalties(state, fork, preset, spec, summary)
+    process_registry_updates(state, preset, spec, summary)
+    process_slashings(state, fork, preset)
+    process_eth1_data_reset(state, preset)
+    process_effective_balance_updates(state, preset)
+    process_slashings_reset(state, preset)
+    process_randao_mixes_reset(state, preset)
+    process_historical_update(state, fork, preset, T)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(state, preset, T)
+    return summary

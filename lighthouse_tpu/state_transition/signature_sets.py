@@ -1,0 +1,185 @@
+"""Signature-set builders for every consensus message kind.
+
+Counterpart of ``/root/reference/consensus/state_processing/src/
+per_block_processing/signature_sets.rs:74-599``.  Each builder returns a
+:class:`~lighthouse_tpu.crypto.bls.SignatureSet` {aggregate signature,
+signing keys, message}; the verifier batches them into ONE
+random-linear-combination multi-pairing — the funnel that makes per-slot
+crypto a single device launch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls import PublicKey, Signature, SignatureSet
+from ..types.chain_spec import Domain
+from .committees import get_attesting_indices, get_beacon_proposer_index
+from .helpers import (
+    compute_epoch_at_slot,
+    compute_signing_root,
+    get_domain,
+)
+
+
+class SignatureSetError(ValueError):
+    pass
+
+
+class PubkeyCache:
+    """Decompressed, subgroup-checked pubkeys by validator index — the
+    ``ValidatorPubkeyCache`` seam
+    (``beacon_node/beacon_chain/src/validator_pubkey_cache.rs:18-161``)."""
+
+    def __init__(self):
+        self._by_index: dict[int, PublicKey] = {}
+        self._index_by_pubkey: dict[bytes, int] = {}
+
+    def get(self, registry, index: int) -> PublicKey:
+        pk = self._by_index.get(index)
+        if pk is None:
+            raw = registry.col("pubkey")[index].tobytes()
+            pk = PublicKey.deserialize(raw)
+            self._by_index[index] = pk
+            self._index_by_pubkey[raw] = index
+        return pk
+
+    def index_of(self, registry, pubkey: bytes) -> int | None:
+        idx = self._index_by_pubkey.get(pubkey)
+        if idx is not None:
+            return idx
+        # Fall back to a vectorized column scan, then memoize.
+        pks = registry.col("pubkey")
+        target = np.frombuffer(pubkey, dtype=np.uint8)
+        hits = np.flatnonzero((pks == target).all(axis=1))
+        if hits.size == 0:
+            return None
+        idx = int(hits[0])
+        self._index_by_pubkey[pubkey] = idx
+        return idx
+
+
+def block_proposal_signature_set(state, signed_block, pubkey_cache, preset,
+                                 block_root: bytes | None = None) -> SignatureSet:
+    block = signed_block.message
+    proposer = block.proposer_index
+    if proposer != get_beacon_proposer_index(state, preset, slot=block.slot):
+        raise SignatureSetError(f"wrong proposer index {proposer}")
+    domain = get_domain(state, Domain.BEACON_PROPOSER,
+                        compute_epoch_at_slot(block.slot,
+                                              preset.SLOTS_PER_EPOCH), preset)
+    root = block_root if block_root is not None else block.tree_hash_root()
+    return SignatureSet(
+        signature=Signature.deserialize(signed_block.signature),
+        signing_keys=[pubkey_cache.get(state.validators, proposer)],
+        message=compute_signing_root(root, domain))
+
+
+def randao_signature_set(state, block, pubkey_cache, preset) -> SignatureSet:
+    epoch = compute_epoch_at_slot(block.slot, preset.SLOTS_PER_EPOCH)
+    domain = get_domain(state, Domain.RANDAO, epoch, preset)
+    from ..ssz import uint64 as _u64
+    return SignatureSet(
+        signature=Signature.deserialize(block.body.randao_reveal),
+        signing_keys=[pubkey_cache.get(state.validators, block.proposer_index)],
+        message=compute_signing_root(_u64.hash_tree_root(epoch), domain))
+
+
+def block_header_signature_set(state, signed_header, pubkey_cache,
+                               preset) -> SignatureSet:
+    header = signed_header.message
+    domain = get_domain(state, Domain.BEACON_PROPOSER,
+                        compute_epoch_at_slot(header.slot,
+                                              preset.SLOTS_PER_EPOCH), preset)
+    return SignatureSet(
+        signature=Signature.deserialize(signed_header.signature),
+        signing_keys=[pubkey_cache.get(state.validators,
+                                       header.proposer_index)],
+        message=compute_signing_root(header, domain))
+
+
+def indexed_attestation_signature_set(state, indices, signature_bytes, data,
+                                      pubkey_cache, preset) -> SignatureSet:
+    domain = get_domain(state, Domain.BEACON_ATTESTER, data.target.epoch,
+                        preset)
+    keys = [pubkey_cache.get(state.validators, int(i)) for i in indices]
+    return SignatureSet(
+        signature=Signature.deserialize(signature_bytes),
+        signing_keys=keys,
+        message=compute_signing_root(data, domain))
+
+
+def attestation_signature_set(state, attestation, pubkey_cache,
+                              preset) -> SignatureSet:
+    indices = get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits, preset)
+    return indexed_attestation_signature_set(
+        state, indices, attestation.signature, attestation.data,
+        pubkey_cache, preset)
+
+
+def voluntary_exit_signature_set(state, signed_exit, pubkey_cache,
+                                 preset) -> SignatureSet:
+    exit = signed_exit.message
+    domain = get_domain(state, Domain.VOLUNTARY_EXIT, exit.epoch, preset)
+    return SignatureSet(
+        signature=Signature.deserialize(signed_exit.signature),
+        signing_keys=[pubkey_cache.get(state.validators,
+                                       exit.validator_index)],
+        message=compute_signing_root(exit, domain))
+
+
+def sync_aggregate_signature_set(state, sync_aggregate, slot: int,
+                                 block_root_fn, preset) -> SignatureSet | None:
+    """Signature over the previous slot's block root by the participating
+    sync-committee subset.  ``block_root_fn(slot)`` supplies the root
+    (``sync_committee_verification``-style).  Returns None when no bits are
+    set and the signature is infinity (valid empty aggregate)."""
+    bits = np.asarray(sync_aggregate.sync_committee_bits, dtype=bool)
+    sig = Signature.deserialize(sync_aggregate.sync_committee_signature)
+    if not bits.any():
+        if sig.point is None:
+            return None
+        raise SignatureSetError("non-infinity signature with empty bits")
+    previous_slot = max(slot, 1) - 1
+    domain = get_domain(state, Domain.SYNC_COMMITTEE,
+                        compute_epoch_at_slot(previous_slot,
+                                              preset.SLOTS_PER_EPOCH), preset)
+    pubkeys = [PublicKey.deserialize(state.current_sync_committee.pubkeys[i])
+               for i in np.flatnonzero(bits)]
+    return SignatureSet(
+        signature=sig,
+        signing_keys=pubkeys,
+        message=compute_signing_root(block_root_fn(previous_slot), domain))
+
+
+def bls_to_execution_change_signature_set(state, signed_change,
+                                          genesis_fork_version: bytes,
+                                          preset) -> SignatureSet:
+    """Signed with the GENESIS fork version regardless of current fork
+    (capella spec; ``signature_sets.rs`` bls_execution_change arm)."""
+    from .helpers import compute_domain
+    change = signed_change.message
+    domain = compute_domain(Domain.BLS_TO_EXECUTION_CHANGE,
+                            genesis_fork_version,
+                            state.genesis_validators_root)
+    return SignatureSet(
+        signature=Signature.deserialize(signed_change.signature),
+        signing_keys=[PublicKey.deserialize(change.from_bls_pubkey)],
+        message=compute_signing_root(change, domain))
+
+
+def deposit_signature_set(deposit_data, T,
+                          genesis_fork_version: bytes = bytes(4)) -> SignatureSet:
+    """Deposits sign over DepositMessage with the genesis fork version and an
+    EMPTY genesis_validators_root (spec ``is_valid_deposit_signature``)."""
+    from .helpers import compute_domain
+    msg = T.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount)
+    domain = compute_domain(Domain.DEPOSIT, genesis_fork_version)
+    return SignatureSet(
+        signature=Signature.deserialize(deposit_data.signature),
+        signing_keys=[PublicKey.deserialize(deposit_data.pubkey)],
+        message=compute_signing_root(msg, domain))
