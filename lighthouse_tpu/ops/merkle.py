@@ -38,6 +38,29 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+# Below this many nodes a batch is dispatched on the host: per-launch overhead
+# (and per-shape XLA compiles) dwarf the hash work, mirroring how the
+# reference only parallelises the big trees (``tree_hash_cache.rs:25-33``).
+HOST_DISPATCH_THRESHOLD = 4096
+
+
+def hash64_host_words(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """Host hashlib counterpart of :func:`hash64` over ``(n, 8)`` u32 words."""
+    shape = left.shape
+    l2 = left.reshape(-1, 8)
+    r2 = right.reshape(-1, 8)
+    n = l2.shape[0]
+    lb = np.ascontiguousarray(l2.astype(">u4")).tobytes()
+    rb = np.ascontiguousarray(r2.astype(">u4")).tobytes()
+    out = bytearray(32 * n)
+    sha256 = hashlib.sha256
+    for i in range(n):
+        o = 32 * i
+        out[o:o + 32] = sha256(lb[o:o + 32] + rb[o:o + 32]).digest()
+    return (np.frombuffer(bytes(out), dtype=">u4").astype(np.uint32)
+            .reshape(shape))
+
+
 @partial(jax.jit, static_argnames=("depth", "base_level"))
 def merkleize(leaves: jnp.ndarray, depth: int, base_level: int = 0) -> jnp.ndarray:
     """Root of a depth-``depth`` tree over ``leaves`` ``(n, 8)`` u32, n = 2^k ≤ 2^depth.
@@ -65,6 +88,29 @@ def merkleize(leaves: jnp.ndarray, depth: int, base_level: int = 0) -> jnp.ndarr
     root = level[0]
     while lvl < depth:
         root = hash64(root, jnp.asarray(ZERO_HASHES[lvl]))
+        lvl += 1
+    return root
+
+
+def merkleize_auto(leaves: np.ndarray, depth: int,
+                   base_level: int = 0) -> np.ndarray:
+    """:func:`merkleize` with host dispatch for small batches.
+
+    Same contract (power-of-two ``(n, 8)`` u32 leaves, total tree ``depth``);
+    returns an ``(8,)`` u32 root on whichever backend ran.
+    """
+    n = leaves.shape[0]
+    assert n == _next_pow2(n), "pad leaf count to a power of two first"
+    if n > HOST_DISPATCH_THRESHOLD:
+        return np.asarray(merkleize(jnp.asarray(leaves), depth, base_level))
+    level = np.asarray(leaves, dtype=np.uint32)
+    lvl = base_level
+    while level.shape[0] > 1:
+        level = hash64_host_words(level[0::2], level[1::2])
+        lvl += 1
+    root = level[0]
+    while lvl < depth:
+        root = hash64_host_words(root[None], ZERO_HASHES[lvl][None])[0]
         lvl += 1
     return root
 

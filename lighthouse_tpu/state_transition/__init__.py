@@ -13,7 +13,6 @@ from .helpers import (
     compute_start_slot_at_epoch,
     current_epoch,
     get_active_validator_indices,
-    get_beacon_proposer_index_helpers_stub,
 )
 from .per_block import (
     BlockProcessingError,

@@ -15,7 +15,11 @@ from __future__ import annotations
 import numpy as np
 
 from ..ssz.core import SszError, SszType
-from ..ops.merkle import _next_pow2, merkleize, mix_in_length
+from ..ops.merkle import (
+    _next_pow2,
+    merkleize_auto,
+    mix_in_length_host,
+)
 from ..ops.sha256 import words_to_bytes
 
 
@@ -40,10 +44,13 @@ def device_merkle_root(chunk_words: np.ndarray, limit_chunks: int,
         padded = np.zeros((width, 8), dtype=np.uint32)
         padded[:k] = chunk_words
         chunk_words = padded
-    root = merkleize(np.asarray(chunk_words, dtype=np.uint32), depth)
+    root = words_to_bytes(
+        merkleize_auto(np.asarray(chunk_words, dtype=np.uint32), depth))
     if length_mixin is not None:
-        root = mix_in_length(root, np.uint32(length_mixin))
-    return words_to_bytes(np.asarray(root))
+        # SSZ mixes a 256-bit LE length; Python ints are exact here, so even
+        # >2^32-entry lists (registry limit is 2^40) hash correctly.
+        root = mix_in_length_host(root, int(length_mixin))
+    return root
 
 
 class Roots(np.ndarray):

@@ -224,14 +224,17 @@ class ValidatorRegistry:
         """Per-validator hash_tree_roots as ``(n, 8)`` u32 words — one
         batched device program (vs rayon-per-arena in the reference,
         ``tree_hash_cache.rs:535-556``)."""
+        from ..ops.merkle import HOST_DISPATCH_THRESHOLD, hash64_host_words
         n = self._n
         if n == 0:
             return np.zeros((0, 8), dtype=np.uint32)
+        h64 = (hash64_host_words if n <= HOST_DISPATCH_THRESHOLD
+               else lambda a, b: np.asarray(hash64(a, b)))
         pk = self.pubkey[:n]
         pk_hi = np.zeros((n, 32), dtype=np.uint8)
         pk_hi[:, :16] = pk[:, 32:]
-        pubkey_root = hash64(bytes_col_to_words(pk[:, :32]),
-                             bytes_col_to_words(pk_hi))
+        pubkey_root = h64(bytes_col_to_words(pk[:, :32]),
+                          bytes_col_to_words(pk_hi))
         leaves = np.stack([
             np.asarray(pubkey_root),
             bytes_col_to_words(self.withdrawal_credentials[:n]),
@@ -242,9 +245,9 @@ class ValidatorRegistry:
             u64_to_chunk_words(self.exit_epoch[:n]),
             u64_to_chunk_words(self.withdrawable_epoch[:n]),
         ], axis=1)  # (n, 8, 8)
-        l1 = hash64(leaves[:, 0::2], leaves[:, 1::2])   # (n, 4, 8)
-        l2 = hash64(l1[:, 0::2], l1[:, 1::2])           # (n, 2, 8)
-        l3 = hash64(l2[:, 0], l2[:, 1])                 # (n, 8)
+        l1 = h64(leaves[:, 0::2], leaves[:, 1::2])   # (n, 4, 8)
+        l2 = h64(l1[:, 0::2], l1[:, 1::2])           # (n, 2, 8)
+        l3 = h64(l2[:, 0], l2[:, 1])                 # (n, 8)
         return np.asarray(l3)
 
     def hash_tree_root(self, limit: int) -> bytes:
