@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import secrets
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Iterable, List, Optional, Sequence
 
 from . import curve as C
@@ -43,6 +44,27 @@ RAND_BITS = 64
 
 class BlsError(ValueError):
     pass
+
+
+@lru_cache(maxsize=1 << 16)
+def _g1_point_checked(data: bytes):
+    """Decompress + subgroup-check a G1 pubkey encoding, memoized by bytes —
+    the decompressed-pubkey cache role of ``validator_pubkey_cache.rs``
+    pushed down to the codec (pure function of the encoding)."""
+    point = C.g1_decompress(data)
+    if point is None:
+        raise BlsError("infinity public key is invalid")
+    if not C.g1_subgroup_check(point):
+        raise BlsError("public key not in the G1 subgroup")
+    return point
+
+
+@lru_cache(maxsize=1 << 16)
+def _g2_point_checked(data: bytes):
+    point = C.g2_decompress(data)
+    if point is not None and not C.g2_subgroup_check(point):
+        raise BlsError("signature not in the G2 subgroup")
+    return point
 
 
 @dataclass(frozen=True)
@@ -88,12 +110,7 @@ class PublicKey:
     def deserialize(cls, data: bytes) -> "PublicKey":
         if len(data) != PUBLIC_KEY_BYTES_LEN:
             raise BlsError(f"public key must be {PUBLIC_KEY_BYTES_LEN} bytes")
-        point = C.g1_decompress(data)
-        if point is None:
-            raise BlsError("infinity public key is invalid")
-        if not C.g1_subgroup_check(point):
-            raise BlsError("public key not in the G1 subgroup")
-        return cls(point)
+        return cls(_g1_point_checked(bytes(data)))
 
     def serialize(self) -> bytes:
         return C.g1_compress(self.point)
@@ -115,10 +132,7 @@ class Signature:
     def deserialize(cls, data: bytes) -> "Signature":
         if len(data) != SIGNATURE_BYTES_LEN:
             raise BlsError(f"signature must be {SIGNATURE_BYTES_LEN} bytes")
-        point = C.g2_decompress(data)
-        if point is not None and not C.g2_subgroup_check(point):
-            raise BlsError("signature not in the G2 subgroup")
-        return cls(point)
+        return cls(_g2_point_checked(bytes(data)))
 
     def serialize(self) -> bytes:
         return C.g2_compress(self.point)

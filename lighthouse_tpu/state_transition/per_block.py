@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import enum
 import math
+from functools import lru_cache
 
 import numpy as np
 
@@ -108,9 +109,11 @@ def process_block(state, signed_block, fork: ForkName, preset, spec, T,
             block_root=verify_block_root))
 
     process_block_header(state, block, preset, T)
-    if fork >= ForkName.CAPELLA:
-        process_withdrawals(state, block.body.execution_payload, preset, T)
-    if fork >= ForkName.BELLATRIX:
+    if fork >= ForkName.BELLATRIX and is_execution_enabled(state, block.body):
+        # Pre-merge-transition blocks carry the default payload and skip both
+        # steps (``per_block_processing.rs`` is_execution_enabled gate).
+        if fork >= ForkName.CAPELLA:
+            process_withdrawals(state, block.body.execution_payload, preset, T)
         process_execution_payload(state, block.body, fork, preset, spec, T,
                                   payload_verifier)
     process_randao(state, block, preset, acc, pubkey_cache,
@@ -182,7 +185,8 @@ def process_operations(state, body, fork, preset, spec, T, acc,
         process_attester_slashing(state, op, fork, preset, spec, acc,
                                   pubkey_cache)
     for op in body.attestations:
-        process_attestation(state, op, fork, preset, spec, acc, pubkey_cache)
+        process_attestation(state, op, fork, preset, spec, T, acc,
+                            pubkey_cache)
     for op in body.deposits:
         process_deposit(state, op, preset, spec, T)
     for op in body.voluntary_exits:
@@ -278,7 +282,7 @@ def get_attestation_participation_flag_indices(state, data, inclusion_delay,
     return flags
 
 
-def process_attestation(state, attestation, fork, preset, spec, acc,
+def process_attestation(state, attestation, fork, preset, spec, T, acc,
                         pubkey_cache) -> None:
     data = attestation.data
     cur, prev = current_epoch(state, preset), previous_epoch(state, preset)
@@ -298,6 +302,25 @@ def process_attestation(state, attestation, fork, preset, spec, acc,
                                     preset)
     acc.add(sigs.indexed_attestation_signature_set(
         state, indices, attestation.signature, data, pubkey_cache, preset))
+
+    if fork == ForkName.PHASE0:
+        # Phase0 records a PendingAttestation; rewards happen per-epoch
+        # (``per_block_processing/process_operations.rs`` base arm).
+        if data.target.epoch == cur:
+            justified = state.current_justified_checkpoint
+            pending_list = state.current_epoch_attestations
+        else:
+            justified = state.previous_justified_checkpoint
+            pending_list = state.previous_epoch_attestations
+        if data.source != justified:
+            raise BlockProcessingError(
+                "attestation source != justified checkpoint")
+        pending_list.append(T.PendingAttestation(
+            aggregation_bits=attestation.aggregation_bits,
+            data=data,
+            inclusion_delay=state.slot - data.slot,
+            proposer_index=get_beacon_proposer_index(state, preset)))
+        return
 
     inclusion_delay = state.slot - data.slot
     flags = get_attestation_participation_flag_indices(
@@ -476,9 +499,25 @@ def process_sync_aggregate(state, aggregate, preset, spec, T, acc) -> None:
 # Execution payload + withdrawals (bellatrix / capella)
 # ---------------------------------------------------------------------------
 
+@lru_cache(maxsize=None)
+def _default_header_root(header_cls: type) -> bytes:
+    return header_cls().tree_hash_root()
+
+
 def is_merge_transition_complete(state) -> bool:
     header = state.latest_execution_payload_header
-    return type(header)().tree_hash_root() != header.tree_hash_root()
+    return _default_header_root(type(header)) != header.tree_hash_root()
+
+
+def is_merge_transition_block(state, body) -> bool:
+    payload = body.execution_payload
+    return (not is_merge_transition_complete(state)
+            and payload != type(payload)())
+
+
+def is_execution_enabled(state, body) -> bool:
+    complete = is_merge_transition_complete(state)
+    return complete or body.execution_payload != type(body.execution_payload)()
 
 
 def compute_timestamp_at_slot(state, spec, preset) -> int:

@@ -1,0 +1,11 @@
+"""In-process test rigs — the ``BeaconChainHarness`` layer.
+
+Counterpart of ``/root/reference/beacon_node/beacon_chain/src/test_utils.rs``
+and ``consensus/types/src/test_utils/``: deterministic interop keypairs, a
+block-building harness that signs every message kind, and manual slot
+control.  Used by the test suite and usable by downstream integration rigs.
+"""
+
+from .harness import StateHarness
+
+__all__ = ["StateHarness"]

@@ -1,0 +1,103 @@
+"""Shuffle + committee tests.
+
+Covers the swap-or-not shuffle (scalar/vectorized parity, permutation
+property, determinism — the test style of
+``/root/reference/consensus/swap_or_not_shuffle/src/lib.rs`` tests) and the
+committee cache invariants (full partition per epoch, matching the
+``CommitteeCache`` tests in
+``/root/reference/consensus/types/src/beacon_state/committee_cache/tests.rs``).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.state_transition.shuffle import (
+    compute_proposer_index,
+    compute_shuffled_index,
+    shuffled_positions,
+)
+from lighthouse_tpu.state_transition.committees import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_committee_cache,
+    get_committee_count_per_slot,
+)
+
+
+SEED = bytes(range(32))
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 33, 100, 333])
+def test_shuffled_positions_is_permutation(n):
+    perm = shuffled_positions(n, SEED, 10)
+    assert sorted(int(x) for x in perm) == list(range(n))
+
+
+@pytest.mark.parametrize("n", [1, 5, 64, 100])
+def test_scalar_matches_vectorized(n):
+    perm = shuffled_positions(n, SEED, 10)
+    scalar = np.array([compute_shuffled_index(j, n, SEED, 10)
+                       for j in range(n)], dtype=np.int64)
+    assert np.array_equal(perm.astype(np.int64), scalar)
+
+
+def test_shuffle_deterministic_and_seed_sensitive():
+    a = shuffled_positions(100, SEED, 10)
+    b = shuffled_positions(100, SEED, 10)
+    c = shuffled_positions(100, bytes(32), 10)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_compute_shuffled_index_bounds():
+    with pytest.raises(Exception):
+        compute_shuffled_index(5, 5, SEED, 10)
+
+
+@pytest.fixture(scope="module")
+def harness_state():
+    B.set_backend("fake")
+    from lighthouse_tpu.testing import StateHarness
+    h = StateHarness(n_validators=64)
+    yield h
+    B.set_backend("python")
+
+
+def test_committees_partition_epoch(harness_state):
+    """Every active validator attests exactly once per epoch."""
+    h = harness_state
+    preset = h.preset
+    seen = []
+    for slot in range(preset.SLOTS_PER_EPOCH):
+        for index in range(get_committee_count_per_slot(h.state, 0, preset)):
+            seen.extend(int(v) for v in
+                        get_beacon_committee(h.state, slot, index, preset))
+    assert sorted(seen) == list(range(64))
+
+
+def test_committee_cache_epoch_window(harness_state):
+    h = harness_state
+    with pytest.raises(ValueError):
+        get_committee_cache(h.state, 5, h.preset)
+
+
+def test_proposer_is_active_and_memoized(harness_state):
+    h = harness_state
+    p1 = get_beacon_proposer_index(h.state, h.preset)
+    p2 = get_beacon_proposer_index(h.state, h.preset)
+    assert p1 == p2
+    assert 0 <= p1 < 64
+
+
+def test_proposer_effective_balance_weighting():
+    """A validator with tiny effective balance is (almost) never proposer."""
+    eff = np.full(64, 32_000_000_000, dtype=np.uint64)
+    eff[0] = 1_000_000_000  # 1/32 the stake
+    indices = np.arange(64, dtype=np.uint64)
+    wins = sum(
+        compute_proposer_index(eff, indices,
+                               bytes([i]) + SEED[1:], 10, 32_000_000_000) == 0
+        for i in range(200))
+    # Expected ≈ 200/64 * (1/32) ≈ 0.1; allow generous slack.
+    assert wins <= 4
