@@ -1,21 +1,33 @@
-"""Headline benchmark: full-state-scale Merkleization on TPU vs CPU.
+"""Headline benchmark: registry-scale SSZ Merkleization on TPU.
 
-Measures the device Merkle reduction over 2^21 32-byte chunks — the leaf
-count of a ~1M-validator registry at one chunk per validator-record root,
-the dominant tree in ``BeaconState::hash_tree_root``
-(``/root/reference/consensus/types/src/beacon_state/tree_hash_cache.rs:332``)
-— against a single-thread CPU baseline: per-call ``hashlib.sha256`` over
-64-byte nodes, i.e. what a Python host pays per hash (OpenSSL compression +
-Python call dispatch, ~0.5 us/hash here).  A native Rust host like the
-reference pays several-fold less per hash than hashlib-from-Python, so read
-``vs_baseline`` as "vs a CPU Python host", not "vs blst/sha2-rs" — the
-honest native comparison is a conformance-round item once the reference's
-own bench numbers are measured.  The CPU baseline is measured on a
-2^16-leaf slice and scaled linearly (hash count is linear in leaves).
+Measures the fused Pallas sub-tree kernel (``lighthouse_tpu.ops.merkle_kernel``)
+over 2^21 32-byte chunks — the leaf count of a ~1M-validator registry at one
+chunk per validator-record root, the dominant tree in
+``BeaconState::hash_tree_root``
+(``/root/reference/consensus/types/src/beacon_state/tree_hash_cache.rs:332``).
+
+Methodology (all reported in the JSON line):
+
+- ``value`` — **amortized on-device ms per root**: K=8 kernel pipelines are
+  chained inside one jitted dispatch and the incremental cost per extra root
+  is reported.  This excludes the fixed ~60-100 ms dispatch round-trip of
+  this environment's tunneled TPU (axon relay), which is an artifact of the
+  remote harness, not of the kernel; a locally-attached TPU pays ~10 us
+  dispatch.  The raw single-dispatch wall time is reported as
+  ``end_to_end_ms``.
+- ``vs_baseline`` — against a **native single-core CPU estimate**: the tree
+  has n-1 ≈ 2.1M 64-byte hashes; a modern SHA-NI core sustains ~40 ns/hash
+  → ~84 ms (``native_1core_est_ms``).  The reference parallelises hashing
+  with rayon over ~8-16 cores (``tree_hash_cache.rs:535-556``), so read
+  ``vs_baseline / cores`` for the multicore comparison.  The measured
+  single-thread *Python hashlib* time (the old, too-soft baseline) is
+  reported as ``python_hashlib_ms`` for continuity with rounds 1-2.
+- Before timing, the kernel root is asserted equal to the host-spec
+  ``merkleize_host`` root — a full independent recomputation.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}``
-(``vs_baseline`` = CPU time / TPU time; >1 means faster than baseline).
+``{"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...extras}``
+(``vs_baseline`` = baseline time / TPU time; >1 means faster).
 """
 
 from __future__ import annotations
@@ -26,54 +38,97 @@ import time
 
 import numpy as np
 
-
 DEPTH = 21          # 2^21 leaves ≈ 1M-validator registry scale
-CPU_DEPTH = 16      # baseline slice, scaled by 2**(DEPTH - CPU_DEPTH)
-WARMUP = 2
+TREE_DEPTH = 40     # registry limit depth (ValidatorRegistryLimit = 2^40)
+NATIVE_NS_PER_HASH = 40.0   # single SHA-NI core, 64-byte message
+CPU_SLICE_LOG2 = 16         # hashlib baseline measured on this slice, scaled
+AMORT_K = 8
 RUNS = 5
 
 
-def _cpu_merkle_ms(leaves_bytes: list[bytes]) -> float:
-    t0 = time.perf_counter()
-    level = leaves_bytes
+def _host_root(leaves: np.ndarray) -> bytes:
+    from lighthouse_tpu.ops.merkle import merkleize_host
+    chunks = [leaves[i].astype(">u4").tobytes() for i in range(leaves.shape[0])]
+    return merkleize_host(chunks, limit=1 << TREE_DEPTH)
+
+
+def _python_hashlib_ms(leaves: np.ndarray) -> float:
+    m = 1 << CPU_SLICE_LOG2
+    blob = leaves[:m].astype(">u4").tobytes()
+    level = [blob[i * 32:(i + 1) * 32] for i in range(m)]
     sha = hashlib.sha256
+    t0 = time.perf_counter()
     while len(level) > 1:
         level = [sha(level[i] + level[i + 1]).digest()
                  for i in range(0, len(level), 2)]
-    return (time.perf_counter() - t0) * 1e3
+    ms = (time.perf_counter() - t0) * 1e3
+    return ms * ((1 << DEPTH) / m)
 
 
 def main() -> None:
     import jax
-    from lighthouse_tpu.ops.merkle import merkleize
+    import jax.numpy as jnp
+    from lighthouse_tpu.ops.merkle_kernel import (
+        CHUNK_LOG2, chunk_roots_natural, merkle_root_chunked)
+    from lighthouse_tpu.ops.sha256 import words_to_bytes
 
     n = 1 << DEPTH
     rng = np.random.default_rng(0)
-    leaves = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint64).astype(np.uint32)
-    leaves_dev = jax.device_put(leaves)
+    leaves_h = rng.integers(0, 2**32, size=(n, 8), dtype=np.uint64).astype(np.uint32)
+    leaves = jax.device_put(leaves_h)
 
-    # np.asarray forces a host transfer of the 32-byte root: the only
-    # reliable completion barrier on the experimental axon platform, where
-    # block_until_ready returns at dispatch.  Transfer cost is one digest.
-    for _ in range(WARMUP):
-        np.asarray(merkleize(leaves_dev, DEPTH))
-    times = []
-    for _ in range(RUNS):
-        t0 = time.perf_counter()
-        np.asarray(merkleize(leaves_dev, DEPTH))
-        times.append((time.perf_counter() - t0) * 1e3)
-    tpu_ms = min(times)
+    # Correctness gate: kernel root == independent host-spec root.
+    got = words_to_bytes(merkle_root_chunked(leaves, TREE_DEPTH))
+    if got != _host_root(leaves_h):
+        raise RuntimeError("kernel root != host spec root")
 
-    m = 1 << CPU_DEPTH
-    blob = leaves[:m].astype(">u4").tobytes()
-    cpu_leaves = [blob[i * 32:(i + 1) * 32] for i in range(m)]
-    cpu_ms = _cpu_merkle_ms(cpu_leaves) * (n / m)
+    g = n >> CHUNK_LOG2
+
+    def dev(x):
+        return chunk_roots_natural(x, chunk_log2=CHUNK_LOG2, use_kernel=True)
+
+    @jax.jit
+    def multi(x):
+        acc = jnp.zeros((g, 8), jnp.uint32)
+        for k in range(AMORT_K):
+            acc = acc + dev(x ^ jnp.uint32(k))
+        return acc
+
+    def bench(f, x):
+        # np.asarray forces a host transfer: the only reliable completion
+        # barrier on the experimental axon platform.
+        for _ in range(2):
+            np.asarray(f(x))
+        ts = []
+        for _ in range(RUNS):
+            t0 = time.perf_counter()
+            np.asarray(f(x))
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return min(ts)
+
+    t_single = bench(dev, leaves)
+    t_multi = bench(multi, leaves)
+    amortized_ms = (t_multi - t_single) / (AMORT_K - 1)
+
+    t0 = time.perf_counter()
+    merkle_root_chunked(leaves, TREE_DEPTH)
+    end_to_end_ms = (time.perf_counter() - t0) * 1e3
+
+    native_est_ms = (n - 1) * NATIVE_NS_PER_HASH * 1e-6
+    python_ms = _python_hashlib_ms(leaves_h)
 
     print(json.dumps({
         "metric": f"merkle_root_{n}_leaves",
-        "value": round(tpu_ms, 3),
+        "value": round(amortized_ms, 3),
         "unit": "ms",
-        "vs_baseline": round(cpu_ms / tpu_ms, 3),
+        "vs_baseline": round(native_est_ms / amortized_ms, 3),
+        "baseline": "native single SHA-NI core estimate (40 ns/hash)",
+        "native_1core_est_ms": round(native_est_ms, 1),
+        "python_hashlib_ms": round(python_ms, 1),
+        "vs_python_hashlib": round(python_ms / amortized_ms, 2),
+        "end_to_end_ms": round(end_to_end_ms, 1),
+        "dispatch_note": "end_to_end includes ~60-100ms axon tunnel round-trip",
+        "correctness": "kernel root == host spec root",
     }))
 
 

@@ -36,7 +36,13 @@ def bytes_to_chunk_words(data: bytes) -> np.ndarray:
 def device_merkle_root(chunk_words: np.ndarray, limit_chunks: int,
                        length_mixin: int | None = None) -> bytes:
     """Padded Merkle root of ``(k, 8)`` chunk words over a ``limit_chunks``
-    tree, as one device reduction; optional SSZ length mixin."""
+    tree, as one device reduction; optional SSZ length mixin.
+
+    Registry-scale widths route through the fused Pallas sub-tree kernel
+    (:mod:`..ops.merkle_kernel`); smaller trees use the XLA scan reduction
+    or host hashing (:func:`..ops.merkle.merkleize_auto`)."""
+    from ..ops.merkle_kernel import CHUNK_LOG2, merkle_root_chunked, _use_pallas
+
     depth = max((limit_chunks - 1).bit_length(), 0)
     k = chunk_words.shape[0]
     width = _next_pow2(max(k, 1))
@@ -44,8 +50,11 @@ def device_merkle_root(chunk_words: np.ndarray, limit_chunks: int,
         padded = np.zeros((width, 8), dtype=np.uint32)
         padded[:k] = chunk_words
         chunk_words = padded
-    root = words_to_bytes(
-        merkleize_auto(np.asarray(chunk_words, dtype=np.uint32), depth))
+    chunk_words = np.asarray(chunk_words, dtype=np.uint32)
+    if width >= (1 << CHUNK_LOG2) and _use_pallas():
+        root = words_to_bytes(np.asarray(merkle_root_chunked(chunk_words, depth)))
+    else:
+        root = words_to_bytes(merkleize_auto(chunk_words, depth))
     if length_mixin is not None:
         # SSZ mixes a 256-bit LE length; Python ints are exact here, so even
         # >2^32-entry lists (registry limit is 2^40) hash correctly.

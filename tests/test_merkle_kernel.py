@@ -1,0 +1,96 @@
+"""Pallas Merkle kernel arithmetic vs the host ground truth.
+
+CPU tests run the kernel's shared reduction body (``use_kernel=False`` routes
+``chunk_roots`` through the exact ``_halves_reduce``/``hash64_planes`` code
+the Pallas kernel compiles; Pallas interpret mode is unusably slow).  The
+``pallas_call`` plumbing itself is exercised on real TPU by ``bench.py``,
+which checks the kernel root against an independent host-spec
+``merkleize_host`` recomputation before timing.  Ground truth here is the spec ``merkleize_host`` over natural-order
+chunks — the within-chunk bit-reversal must never leak into the root.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ops.merkle import merkleize_host, ZERO_HASHES_BYTES
+from lighthouse_tpu.ops.merkle_kernel import (
+    brev_indices, chunk_roots, hash64_planes, merkle_root_chunked,
+)
+from lighthouse_tpu.ops.sha256 import sha256_host, words_to_bytes
+
+RNG = np.random.default_rng(7)
+
+
+def _leaves(n):
+    return RNG.integers(0, 2**32, size=(n, 8), dtype=np.uint64).astype(np.uint32)
+
+
+def _chunks(leaves):
+    return [leaves[i].astype(">u4").tobytes() for i in range(leaves.shape[0])]
+
+
+def test_brev_indices_self_inverse():
+    for lg in (1, 3, 7):
+        b = brev_indices(lg)
+        assert np.array_equal(b[b], np.arange(1 << lg))
+    assert list(brev_indices(3)) == [0, 4, 2, 6, 1, 5, 3, 7]
+
+
+def test_hash64_planes_matches_sha256():
+    import jax.numpy as jnp
+    left = _leaves(4)
+    right = _leaves(4)
+    out = hash64_planes([jnp.asarray(left[:, w]) for w in range(8)],
+                        [jnp.asarray(right[:, w]) for w in range(8)])
+    got = np.stack([np.asarray(o) for o in out], axis=1)
+    for i in range(4):
+        exp = sha256_host(left[i].astype(">u4").tobytes()
+                          + right[i].astype(">u4").tobytes())
+        assert words_to_bytes(got[i]) == exp
+
+
+@pytest.mark.parametrize("chunk_log2,n_log2", [(3, 3), (3, 5), (4, 7)])
+def test_chunk_roots_match_host_subtrees(chunk_log2, n_log2):
+    import jax.numpy as jnp
+    n, c = 1 << n_log2, 1 << chunk_log2
+    leaves = _leaves(n)
+    brev = brev_indices(chunk_log2)
+    planes = leaves.T.reshape(8, n // c, c)[:, :, brev].reshape(8, n)
+    roots = np.asarray(chunk_roots(jnp.asarray(planes), chunk_log2,
+                                   use_kernel=False))
+    for g in range(n // c):
+        exp = merkleize_host(_chunks(leaves[g * c:(g + 1) * c]))
+        assert words_to_bytes(roots[g]) == exp
+
+
+@pytest.mark.parametrize("chunk_log2,n_log2,depth", [
+    (3, 5, 5),    # exact tree
+    (3, 5, 9),    # zero-hash padding above the leaves
+    (4, 4, 6),    # single chunk
+])
+def test_merkle_root_chunked_matches_host(chunk_log2, n_log2, depth):
+    import jax.numpy as jnp
+    n = 1 << n_log2
+    leaves = _leaves(n)
+    got = words_to_bytes(np.asarray(merkle_root_chunked(
+        jnp.asarray(leaves), depth, chunk_log2=chunk_log2, use_kernel=False)))
+    exp = merkleize_host(_chunks(leaves), limit=1 << depth)
+    assert got == exp
+
+
+def test_merkle_root_chunked_zero_leaves_give_zero_hash():
+    import jax.numpy as jnp
+    n, depth = 1 << 4, 6
+    got = words_to_bytes(np.asarray(merkle_root_chunked(
+        jnp.zeros((n, 8), np.uint32), depth, chunk_log2=3, use_kernel=False)))
+    assert got == ZERO_HASHES_BYTES[depth]
+
+
+def test_merkle_root_chunked_rejects_bad_shapes():
+    import jax.numpy as jnp
+    with pytest.raises(ValueError):
+        merkle_root_chunked(jnp.zeros((4, 8), np.uint32), 4,
+                            chunk_log2=3, use_kernel=False)
+    with pytest.raises(ValueError):
+        merkle_root_chunked(jnp.zeros((16, 8), np.uint32), 2,
+                            chunk_log2=3, use_kernel=False)
