@@ -109,6 +109,11 @@ def main() -> None:
     t_single = bench(dev, leaves)
     t_multi = bench(multi, leaves)
     amortized_ms = (t_multi - t_single) / (AMORT_K - 1)
+    if amortized_ms <= 0:
+        # Dispatch jitter swallowed the added device work; fall back to the
+        # conservative whole-dispatch estimate rather than emit a
+        # nonsensical (zero/negative) denominator.
+        amortized_ms = t_multi / AMORT_K
 
     t0 = time.perf_counter()
     merkle_root_chunked(leaves, TREE_DEPTH)

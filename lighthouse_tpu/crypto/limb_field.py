@@ -1,0 +1,220 @@
+"""Batched BLS12-381 base-field arithmetic in 16-bit limbs for the TPU VPU.
+
+This is the foundation of the ``tpu`` BLS backend — the device counterpart
+of blst's assembly field arithmetic (the backend wrapped by
+``/root/reference/crypto/bls/src/impls/blst.rs``).  A TPU has no 64-bit
+integer multiplier, so a field element is 26 little-endian 16-bit limbs held
+in ``uint32`` lanes (R = 2^416 > 4N), and every operation is elementwise /
+batched over arbitrary leading dimensions — thousands of independent field
+elements per vector op, which is exactly the shape batched signature
+verification produces.
+
+Representation invariants:
+
+- Public values are **Montgomery residues** ``x·R mod N`` with *normalized*
+  limbs (< 2^16) and value < 2N (lazy reduction — canonicalised only at the
+  host boundary, where python ints take over).
+- ``mont_mul`` is schoolbook column products (26×26 outer product, lo/hi
+  split so every partial term fits uint32) + word-by-word Montgomery
+  reduction, fully unrolled over the 26 limb positions (static slices; the
+  batch dimension carries the parallelism, not the limb dimension).
+- Sums/differences stay < 4N: with R = 2^416 ≈ 2^35·N there is enormous
+  headroom, so no conditional subtractions exist anywhere on the device.
+
+Host conversion helpers use exact python ints; the pure-python tower
+(:mod:`..fields`) is the semantics oracle in tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .fields import P as N_INT
+
+LIMB_BITS = 16
+LIMBS = 26
+MASK = np.uint32(0xFFFF)
+R_BITS = LIMB_BITS * LIMBS          # 416
+R_INT = 1 << R_BITS
+R_MOD_N = R_INT % N_INT
+R2_MOD_N = (R_INT * R_INT) % N_INT
+RINV_INT = pow(R_INT, -1, N_INT)
+# -N^-1 mod 2^16 for the Montgomery word recurrence.
+N0_INV = np.uint32((-pow(N_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS))
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int (< R) → ``(26,)`` uint32 16-bit limbs, little-endian."""
+    if not 0 <= x < R_INT:
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (LIMB_BITS * i)) & 0xFFFF for i in range(LIMBS)],
+                    dtype=np.uint32)
+
+
+def limbs_to_int(limbs: np.ndarray) -> int:
+    """``(..., 26)`` limbs → python int (no modular reduction)."""
+    limbs = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(limbs))
+
+
+N_LIMBS = int_to_limbs(N_INT)
+N2_LIMBS = int_to_limbs(2 * N_INT)
+N4_LIMBS = int_to_limbs(4 * N_INT)
+
+
+def to_mont(x: int) -> np.ndarray:
+    """Canonical int → Montgomery-domain limbs."""
+    return int_to_limbs((x % N_INT) * R_MOD_N % N_INT)
+
+
+def from_mont(limbs: np.ndarray) -> int:
+    """Montgomery-domain limbs (any lazy representative) → canonical int."""
+    return limbs_to_int(limbs) * RINV_INT % N_INT
+
+
+def to_mont_array(xs) -> np.ndarray:
+    """Sequence/array of ints → ``(..., 26)`` Montgomery limbs."""
+    flat = [to_mont(x) for x in np.asarray(xs, dtype=object).reshape(-1)]
+    out = np.stack(flat) if flat else np.zeros((0, LIMBS), np.uint32)
+    return out.reshape(np.asarray(xs, dtype=object).shape + (LIMBS,))
+
+
+def from_mont_array(limbs: np.ndarray) -> np.ndarray:
+    """``(..., 26)`` Montgomery limbs → object array of canonical ints."""
+    arr = np.asarray(limbs)
+    lead = arr.shape[:-1]
+    flat = arr.reshape(-1, LIMBS)
+    out = np.empty(flat.shape[0], dtype=object)
+    for i in range(flat.shape[0]):
+        out[i] = from_mont(flat[i])
+    return out.reshape(lead)
+
+
+ZERO = np.zeros(LIMBS, dtype=np.uint32)
+ONE_MONT = to_mont(1)
+
+
+# ---------------------------------------------------------------------------
+# Device ops (pure jnp; batched over leading dims; limb axis = -1)
+# ---------------------------------------------------------------------------
+
+def _carry_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Normalize uint32 limb values (< 2^32) to 16-bit limbs, unrolled
+    carry chain.  The value must fit 26 limbs (guaranteed by the < 4N
+    bound; R = 2^416 leaves 33+ spare bits)."""
+    out = []
+    carry = jnp.zeros_like(x[..., 0])
+    for i in range(LIMBS):
+        v = x[..., i] + carry
+        out.append(v & MASK)
+        carry = v >> np.uint32(LIMB_BITS)
+    return jnp.stack(out, axis=-1)
+
+
+def _carry_i32(x: jnp.ndarray) -> jnp.ndarray:
+    """Signed carry normalization (int32 limb values, possibly negative;
+    total value must be in [0, 2^416))."""
+    out = []
+    carry = jnp.zeros_like(x[..., 0])
+    for i in range(LIMBS):
+        v = x[..., i] + carry
+        out.append(v & jnp.int32(0xFFFF))
+        carry = v >> 16  # arithmetic shift: floor division by 2^16
+    return jnp.stack(out, axis=-1).astype(jnp.uint32)
+
+
+def _cond_sub(x: jnp.ndarray, k_limbs: np.ndarray) -> jnp.ndarray:
+    """x - K if x ≥ K else x, branch-free (normalized limb input)."""
+    d = x.astype(jnp.int32) - jnp.asarray(k_limbs, jnp.int32)
+    out = []
+    carry = jnp.zeros_like(d[..., 0])
+    for i in range(LIMBS):
+        v = d[..., i] + carry
+        out.append(v & jnp.int32(0xFFFF))
+        carry = v >> 16
+    d_norm = jnp.stack(out, axis=-1).astype(jnp.uint32)
+    no_borrow = carry == 0
+    return jnp.where(no_borrow[..., None], d_norm, x)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b, conditionally reduced — inputs and output < 2N.
+
+    The < 2N invariant everywhere makes bound reasoning trivial: every
+    value this module hands out is safe for every other op.  The extra
+    conditional-subtract carry pass is ~40 vector ops — noise next to a
+    mont_mul, and the alternative (lazy growing bounds) silently corrupted
+    curve formulas whose ×12 constants pushed intermediates past the
+    subtraction slack."""
+    return _cond_sub(_carry_u32(a + b), N2_LIMBS)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod N (inputs < 2N → a - b + 2N ∈ (0, 4N) → reduced < 2N)."""
+    d = a.astype(jnp.int32) + jnp.asarray(N2_LIMBS, jnp.int32) - b.astype(jnp.int32)
+    return _cond_sub(_carry_i32(d), N2_LIMBS)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    """2N - a ≡ -a (mod N); input < 2N → output ≤ 2N (2N ≡ 0 is a valid
+    lazy zero and the next add/mul handles it)."""
+    d = jnp.asarray(N2_LIMBS, jnp.int32) - a.astype(jnp.int32)
+    return _carry_i32(d)
+
+
+def muls(a: jnp.ndarray, s: int) -> jnp.ndarray:
+    """a · s for a small int 0 ≤ s ≤ 16, reduced back below 2N."""
+    if not 0 <= s <= 16:
+        raise ValueError("small-scalar multiply supports 0..16")
+    x = _carry_u32(a * np.uint32(s))     # < 32N
+    x = _cond_sub(x, int_to_limbs(16 * N_INT))
+    x = _cond_sub(x, int_to_limbs(8 * N_INT))
+    x = _cond_sub(x, N4_LIMBS)
+    return _cond_sub(x, N2_LIMBS)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched Montgomery product a·b·R^-1 mod N.
+
+    Inputs: ``(..., 26)`` uint32, normalized limbs, values < 2^400.
+    Output: normalized limbs, value < 2N.
+    """
+    # Full product as 52 uint32 columns of 16-bit partial terms.
+    prod = a[..., :, None] * b[..., None, :]          # (..., 26, 26)
+    lo = prod & MASK
+    hi = prod >> np.uint32(LIMB_BITS)
+    t = jnp.zeros(a.shape[:-1] + (2 * LIMBS + 1,), jnp.uint32)
+    for i in range(LIMBS):
+        t = t.at[..., i:i + LIMBS].add(lo[..., i, :])
+        t = t.at[..., i + 1:i + 1 + LIMBS].add(hi[..., i, :])
+    # Word-by-word reduction: zero column i with m·N, push carry up.
+    n_lo = jnp.asarray(N_LIMBS & 0xFFFF, jnp.uint32)
+    for i in range(LIMBS):
+        ti = t[..., i]
+        m = (ti * N0_INV) & MASK
+        mn = m[..., None] * n_lo                       # (..., 26) < 2^32
+        t = t.at[..., i:i + LIMBS].add(mn & MASK)
+        t = t.at[..., i + 1:i + 1 + LIMBS].add(mn >> np.uint32(LIMB_BITS))
+        # After the add, column i ≡ 0 mod 2^16; carry its high part.
+        t = t.at[..., i + 1].add(t[..., i] >> np.uint32(LIMB_BITS))
+    return _carry_u32(t[..., LIMBS:2 * LIMBS])
+
+
+def select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise ``mask ? a : b`` with mask broadcast over the limb axis."""
+    return jnp.where(mask[..., None], a, b)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact zero test (mod N) for lazy values < 8N: true iff the value is
+    k·N for k < 8.  One mont_mul by R² would canonicalise, but comparing
+    against the eight multiples directly is cheaper and branch-free."""
+    out = None
+    for k in range(8):
+        eq = jnp.all(a == jnp.asarray(int_to_limbs(k * N_INT)), axis=-1)
+        out = eq if out is None else (out | eq)
+    return out

@@ -1,0 +1,162 @@
+"""Batched Fq2/Fq6/Fq12 tower arithmetic over the 16-bit-limb base field.
+
+Tower structure matches the host oracle (:mod:`..fields`):
+``Fq2 = Fq[u]/(u²+1)``, ``Fq6 = Fq2[v]/(v³-ξ)`` with ``ξ = u+1``,
+``Fq12 = Fq6[w]/(w²-v)``.
+
+Layout: an Fq2 element is ``(..., 2, 26)`` uint32 limbs; Fq6 is
+``(..., 3, 2, 26)``; Fq12 is ``(..., 2, 3, 2, 26)`` — coefficient axes
+mirror the host tuples, limbs innermost.
+
+The TPU-shaped trick: every tower multiply *stacks* its schoolbook
+sub-products along a new leading axis and recurses, so one ``fq12_mul``
+lowers to exactly ONE batched :func:`..limb_field.mont_mul` call over
+4·9·4 = 144 base-field products per element — the VPU sees a single wide
+multiply instead of a tree of small ones.  Additions/negations are plain
+limb ops and broadcast over every coefficient axis unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import limb_field as LF
+
+# Re-exported limb ops work coefficient-wise on any (..., K, 26) stack.
+add = LF.add
+sub = LF.sub
+neg = LF.neg
+select = LF.select
+
+
+# ---------------------------------------------------------------------------
+# Fq2: (..., 2, 26)
+# ---------------------------------------------------------------------------
+
+def fq2_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook: (a0+a1u)(b0+b1u) = (a0b0 - a1b1) + (a0b1 + a1b0)u."""
+    ai = a[..., (0, 1, 0, 1), :]
+    bi = b[..., (0, 1, 1, 0), :]
+    p = LF.mont_mul(ai, bi)  # (..., 4, 26)
+    c0 = LF.sub(p[..., 0, :], p[..., 1, :])
+    c1 = LF.add(p[..., 2, :], p[..., 3, :])
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return fq2_mul(a, a)
+
+
+def fq2_conj(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([a[..., 0, :], LF.neg(a[..., 1, :])], axis=-2)
+
+
+def fq2_muls(a: jnp.ndarray, s: int) -> jnp.ndarray:
+    return LF.muls(a, s)
+
+
+def fq2_mul_by_xi(a: jnp.ndarray) -> jnp.ndarray:
+    """ξ·(a0 + a1u) = (a0 - a1) + (a0 + a1)u  (ξ = 1 + u)."""
+    c0 = LF.sub(a[..., 0, :], a[..., 1, :])
+    c1 = LF.add(a[..., 0, :], a[..., 1, :])
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return LF.is_zero(a[..., 0, :]) & LF.is_zero(a[..., 1, :])
+
+
+# ---------------------------------------------------------------------------
+# Fq6: (..., 3, 2, 26)
+# ---------------------------------------------------------------------------
+
+def fq6_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook over Fq2 with v³ = ξ:
+
+    c0 = a0b0 + ξ(a1b2 + a2b1)
+    c1 = a0b1 + a1b0 + ξ(a2b2)
+    c2 = a0b2 + a1b1 + a2b0
+    """
+    ai = a[..., (0, 1, 2, 0, 1, 2, 0, 1, 2), :, :]
+    bi = b[..., (0, 2, 1, 1, 0, 2, 2, 1, 0), :, :]
+    p = fq2_mul(ai, bi)  # (..., 9, 2, 26): [a0b0,a1b2,a2b1,a0b1,a1b0,a2b2,a0b2,a1b1,a2b0]
+    c0 = LF.add(p[..., 0, :, :],
+                fq2_mul_by_xi(LF.add(p[..., 1, :, :], p[..., 2, :, :])))
+    c1 = LF.add(LF.add(p[..., 3, :, :], p[..., 4, :, :]),
+                fq2_mul_by_xi(p[..., 5, :, :]))
+    c2 = LF.add(LF.add(p[..., 6, :, :], p[..., 7, :, :]), p[..., 8, :, :])
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fq6_mul_by_v(a: jnp.ndarray) -> jnp.ndarray:
+    """v·(a0 + a1v + a2v²) = ξa2 + a0v + a1v²."""
+    return jnp.stack([fq2_mul_by_xi(a[..., 2, :, :]),
+                      a[..., 0, :, :], a[..., 1, :, :]], axis=-3)
+
+
+# ---------------------------------------------------------------------------
+# Fq12: (..., 2, 3, 2, 26)
+# ---------------------------------------------------------------------------
+
+def fq12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a0 + a1w)(b0 + b1w) = (a0b0 + v·a1b1) + (a0b1 + a1b0)w."""
+    ai = a[..., (0, 1, 0, 1), :, :, :]
+    bi = b[..., (0, 1, 1, 0), :, :, :]
+    p = fq6_mul(ai, bi)  # (..., 4, 3, 2, 26)
+    c0 = LF.add(p[..., 0, :, :, :], fq6_mul_by_v(p[..., 1, :, :, :]))
+    c1 = LF.add(p[..., 2, :, :, :], p[..., 3, :, :, :])
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fq12_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return fq12_mul(a, a)
+
+
+def fq12_conj(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([a[..., 0, :, :, :],
+                      LF.neg(a[..., 1, :, :, :])], axis=-4)
+
+
+# ---------------------------------------------------------------------------
+# Host conversions (exact ints; test/boundary only)
+# ---------------------------------------------------------------------------
+
+def fq2_to_limbs(x) -> np.ndarray:
+    """Host Fq2 tuple (c0, c1) → (2, 26) Montgomery limbs."""
+    return np.stack([LF.to_mont(x[0]), LF.to_mont(x[1])])
+
+
+def fq2_from_limbs(a) -> tuple:
+    a = np.asarray(a)
+    return (LF.from_mont(a[..., 0, :]), LF.from_mont(a[..., 1, :]))
+
+
+def fq6_to_limbs(x) -> np.ndarray:
+    return np.stack([fq2_to_limbs(c) for c in x])
+
+
+def fq6_from_limbs(a) -> tuple:
+    a = np.asarray(a)
+    return tuple(fq2_from_limbs(a[i]) for i in range(3))
+
+
+def fq12_to_limbs(x) -> np.ndarray:
+    return np.stack([fq6_to_limbs(c) for c in x])
+
+
+def fq12_from_limbs(a) -> tuple:
+    a = np.asarray(a)
+    return tuple(fq6_from_limbs(a[i]) for i in range(2))
+
+
+FQ12_ONE_LIMBS = None  # initialised below
+
+
+def _init_constants():
+    global FQ12_ONE_LIMBS
+    from . import fields as F
+    FQ12_ONE_LIMBS = fq12_to_limbs(F.FQ12_ONE)
+
+
+_init_constants()

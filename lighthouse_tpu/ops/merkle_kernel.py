@@ -39,17 +39,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .sha256 import _IV, _K, _PAD64_KW
+from .sha256 import _IV, _K, _PAD64_KW, _rotr
 
 U32 = np.uint32
 
 # Default chunk: 2^15 leaves = 1 MiB of VMEM per input block; 15 unrolled
 # levels keep the kernel within Mosaic's scoped-VMEM budget (2^16 overflows).
 CHUNK_LOG2 = 15
-
-
-def _rotr(x, n: int):
-    return (x >> U32(n)) | (x << U32(32 - n))
 
 
 def compress_data_block(state, block16):
@@ -224,8 +220,7 @@ def merkle_root_chunked(leaves, depth: int,
     shared body eagerly — XLA-CPU takes minutes to compile the ~1.5k-op
     unrolled compression chain that Mosaic handles in seconds.)
     """
-    from .merkle import ZERO_HASHES_BYTES, merkleize_host
-    from .sha256 import bytes_to_words, words_to_bytes
+    from .merkle import merkleize_auto
 
     n = leaves.shape[0]
     if n & (n - 1):
@@ -243,11 +238,6 @@ def merkle_root_chunked(leaves, depth: int,
     else:
         roots = np.asarray(_chunk_roots_natural_impl(
             jnp.asarray(leaves), chunk_log2, False))
-    root = merkleize_host([words_to_bytes(roots[i])
-                           for i in range(roots.shape[0])])
-    lvl = chunk_log2 + (roots.shape[0] - 1).bit_length()
-    import hashlib
-    while lvl < depth:
-        root = hashlib.sha256(root + ZERO_HASHES_BYTES[lvl]).digest()
-        lvl += 1
-    return bytes_to_words(root)
+    # Tail: a few dozen single-hash levels — host dispatch via merkleize_auto
+    # (a chain of one-element device launches would be dispatch-bound).
+    return merkleize_auto(roots, depth, base_level=chunk_log2)

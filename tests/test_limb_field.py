@@ -1,0 +1,102 @@
+"""Batched 16-bit-limb Montgomery field vs exact python ints."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import limb_field as LF
+from lighthouse_tpu.crypto.fields import P
+
+RNG = np.random.default_rng(11)
+
+
+def _rand_ints(n):
+    return [int.from_bytes(RNG.bytes(48), "big") % P for _ in range(n)]
+
+
+def test_limb_roundtrip():
+    for x in _rand_ints(8) + [0, 1, P - 1]:
+        assert LF.limbs_to_int(LF.int_to_limbs(x)) == x
+        assert LF.from_mont(LF.to_mont(x)) == x
+
+
+def test_constants():
+    assert (LF.N0_INV * (P & 0xFFFF)) % (1 << 16) == (1 << 16) - 1 or \
+        (int(LF.N0_INV) * P) % (1 << 16) == (1 << 16) - 1
+    # -N^-1 * N ≡ -1 (mod 2^16)
+    assert (int(LF.N0_INV) * P + 1) % (1 << 16) == 0
+    assert LF.R_INT > 4 * P
+
+
+def test_mont_mul_batched():
+    import jax.numpy as jnp
+    xs = _rand_ints(64)
+    ys = _rand_ints(64)
+    a = np.stack([LF.to_mont(x) for x in xs])
+    b = np.stack([LF.to_mont(y) for y in ys])
+    out = np.asarray(LF.mont_mul(jnp.asarray(a), jnp.asarray(b)))
+    for i in range(64):
+        got = LF.from_mont(out[i])
+        assert got == xs[i] * ys[i] % P
+        # lazy bound: value < 2N
+        assert LF.limbs_to_int(out[i]) < 2 * P
+        assert (out[i] <= 0xFFFF).all()
+
+
+def test_mont_mul_multidim():
+    import jax.numpy as jnp
+    xs = np.array(_rand_ints(12), dtype=object).reshape(3, 4)
+    ys = np.array(_rand_ints(12), dtype=object).reshape(3, 4)
+    a = LF.to_mont_array(xs)
+    b = LF.to_mont_array(ys)
+    out = LF.from_mont_array(np.asarray(LF.mont_mul(jnp.asarray(a), jnp.asarray(b))))
+    for i in range(3):
+        for j in range(4):
+            assert out[i, j] == xs[i, j] * ys[i, j] % P
+
+
+def test_add_sub_neg_muls():
+    import jax.numpy as jnp
+    xs = _rand_ints(32)
+    ys = _rand_ints(32)
+    a = jnp.asarray(np.stack([LF.to_mont(x) for x in xs]))
+    b = jnp.asarray(np.stack([LF.to_mont(y) for y in ys]))
+    s = np.asarray(LF.add(a, b))
+    d = np.asarray(LF.sub(a, b))
+    n = np.asarray(LF.neg(a))
+    m3 = np.asarray(LF.muls(a, 3))
+    for i in range(32):
+        assert LF.from_mont(s[i]) == (xs[i] + ys[i]) % P
+        assert LF.from_mont(d[i]) == (xs[i] - ys[i]) % P
+        assert LF.from_mont(n[i]) == (-xs[i]) % P
+        assert LF.from_mont(m3[i]) == 3 * xs[i] % P
+
+
+def test_chained_ops_stay_in_bounds():
+    """A realistic op chain (adds feeding muls feeding subs) stays exact."""
+    import jax.numpy as jnp
+    xs = _rand_ints(16)
+    a = jnp.asarray(np.stack([LF.to_mont(x) for x in xs]))
+    # ((a + a) * a - a) * (a + a + a)
+    t = LF.add(a, a)
+    t = LF.mont_mul(t, a)
+    t = LF.sub(t, a)
+    u = LF.add(LF.add(a, a), a)
+    out = np.asarray(LF.mont_mul(t, u))
+    for i, x in enumerate(xs):
+        # mont_mul divides by R once per call: track the domain exactly.
+        # a = x·R; t = (2xR·xR)/R - xR = (2x² - x)R; u = 3xR
+        # out = t·u/R = (2x²-x)·3x · R
+        exp = (2 * x * x - x) * 3 * x % P
+        assert LF.from_mont(out[i]) == exp
+
+
+def test_select_and_is_zero():
+    import jax.numpy as jnp
+    a = jnp.asarray(np.stack([LF.to_mont(5), LF.to_mont(7)]))
+    b = jnp.asarray(np.stack([LF.to_mont(9), LF.to_mont(11)]))
+    mask = jnp.asarray([True, False])
+    out = np.asarray(LF.select(mask, a, b))
+    assert LF.from_mont(out[0]) == 5 and LF.from_mont(out[1]) == 11
+    z = jnp.asarray(np.stack([
+        LF.ZERO, LF.int_to_limbs(P), LF.int_to_limbs(3 * P), LF.to_mont(1)]))
+    assert np.asarray(LF.is_zero(z)).tolist() == [True, True, True, False]

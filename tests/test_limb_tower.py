@@ -1,0 +1,80 @@
+"""Batched limb tower (Fq2/Fq6/Fq12) vs the pure-python tower oracle."""
+
+import numpy as np
+
+from lighthouse_tpu.crypto import fields as F
+from lighthouse_tpu.crypto import limb_tower as T
+from lighthouse_tpu.crypto.fields import P
+
+RNG = np.random.default_rng(13)
+
+
+def _ri():
+    return int.from_bytes(RNG.bytes(48), "big") % P
+
+
+def _rand_fq2():
+    return (_ri(), _ri())
+
+
+def _rand_fq6():
+    return tuple(_rand_fq2() for _ in range(3))
+
+
+def _rand_fq12():
+    return tuple(_rand_fq6() for _ in range(2))
+
+
+def test_fq2_roundtrip_and_mul():
+    import jax.numpy as jnp
+    xs = [_rand_fq2() for _ in range(8)]
+    ys = [_rand_fq2() for _ in range(8)]
+    a = jnp.asarray(np.stack([T.fq2_to_limbs(x) for x in xs]))
+    b = jnp.asarray(np.stack([T.fq2_to_limbs(y) for y in ys]))
+    prod = np.asarray(T.fq2_mul(a, b))
+    s = np.asarray(T.add(a, b))
+    d = np.asarray(T.sub(a, b))
+    xi = np.asarray(T.fq2_mul_by_xi(a))
+    cj = np.asarray(T.fq2_conj(a))
+    for i in range(8):
+        assert T.fq2_from_limbs(prod[i]) == F.fq2_mul(xs[i], ys[i])
+        assert T.fq2_from_limbs(s[i]) == F.fq2_add(xs[i], ys[i])
+        assert T.fq2_from_limbs(d[i]) == F.fq2_sub(xs[i], ys[i])
+        assert T.fq2_from_limbs(xi[i]) == F.fq2_mul(F.XI, xs[i])
+        assert T.fq2_from_limbs(cj[i]) == F.fq2_conj(xs[i])
+
+
+def test_fq6_mul():
+    import jax.numpy as jnp
+    xs = [_rand_fq6() for _ in range(4)]
+    ys = [_rand_fq6() for _ in range(4)]
+    a = jnp.asarray(np.stack([T.fq6_to_limbs(x) for x in xs]))
+    b = jnp.asarray(np.stack([T.fq6_to_limbs(y) for y in ys]))
+    prod = np.asarray(T.fq6_mul(a, b))
+    mv = np.asarray(T.fq6_mul_by_v(a))
+    for i in range(4):
+        assert T.fq6_from_limbs(prod[i]) == F.fq6_mul(xs[i], ys[i])
+        assert T.fq6_from_limbs(mv[i]) == F.fq6_mul_by_v(xs[i])
+
+
+def test_fq12_mul_sqr_conj():
+    import jax.numpy as jnp
+    xs = [_rand_fq12() for _ in range(3)]
+    ys = [_rand_fq12() for _ in range(3)]
+    a = jnp.asarray(np.stack([T.fq12_to_limbs(x) for x in xs]))
+    b = jnp.asarray(np.stack([T.fq12_to_limbs(y) for y in ys]))
+    prod = np.asarray(T.fq12_mul(a, b))
+    sq = np.asarray(T.fq12_sqr(a))
+    cj = np.asarray(T.fq12_conj(a))
+    for i in range(3):
+        assert T.fq12_from_limbs(prod[i]) == F.fq12_mul(xs[i], ys[i])
+        assert T.fq12_from_limbs(sq[i]) == F.fq12_mul(xs[i], xs[i])
+        assert T.fq12_from_limbs(cj[i]) == F.fq12_conj(xs[i])
+
+
+def test_fq12_one_identity():
+    import jax.numpy as jnp
+    x = _rand_fq12()
+    a = jnp.asarray(T.fq12_to_limbs(x)[None])
+    one = jnp.asarray(T.FQ12_ONE_LIMBS[None])
+    assert T.fq12_from_limbs(np.asarray(T.fq12_mul(a, one))[0]) == x
