@@ -1,0 +1,347 @@
+"""Batched optimal-ate pairing on the TPU — the heart of the ``tpu`` BLS
+backend.
+
+Device counterpart of the host oracle (:mod:`.pairing`) and of blst's
+``verify_multiple_aggregate_signatures`` multi-pairing
+(``/root/reference/crypto/bls/src/impls/blst.rs:36-119``).  Everything is
+batched over a leading lane axis: one call runs B independent Miller loops
+as wide vector ops, then a log2(B) product-reduction shares ONE final
+exponentiation across the whole batch — the product-of-pairings trick.
+
+TPU-shaped choices:
+
+- **Projective Miller loop, affine base points.**  The running point T
+  stays homogeneous projective (no per-step inversions — a field inversion
+  is a 381-bit ladder, ruinous inside a 63-iteration loop), while the fixed
+  points (G1 evaluation point, G2 base point Q) are affine, keeping the
+  line formulas short.
+- **Scanned, not unrolled.**  The 63 Miller iterations and the 64-bit
+  x-power ladders run under ``lax.scan`` with the (static) bit pattern as
+  scanned input — one compiled body instead of a 100k-op unrolled graph.
+  Both branches (double-only vs double-and-add) are computed every
+  iteration and lane-selected; |x| has Hamming weight 6, so this wastes
+  ~45% device work in exchange for ~60× less XLA graph — the right trade
+  until a Pallas rewrite.
+- **Lines as sparse Fq12 with the w³ scaling.**  With the oracle's untwist
+  convention (x/w², y/w³), a line through G2 points evaluated at a G1 point
+  P=(xP,yP), scaled by w³·(any Fq2), is  A + B·v + C·v·w  with A,B,C ∈ Fq2:
+  a "034"-sparse element.  w³ lies in the Fq4 subfield Fq2(v·w), so the
+  easy part of the final exponentiation kills the scaling.
+- **Final exponentiation via x-ladders, cubed.**  The hard part uses the
+  Hayashida–Hayasaka–Teruya decomposition
+      3·(p⁴−p²+1)/r = (u−1)²·(u+p)·(u²+p²−1) + 3
+  (checked exactly in tests), i.e. the device computes the CUBE of the
+  oracle's GT value — identical for the only consumer, the ``== 1`` check
+  (GT has prime order r ≠ 3).  Five 64-bit x-ladders instead of a 2700-bit
+  exponentiation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import fields as F
+from . import limb_field as LF
+from . import limb_tower as T
+from . import limb_curve as LC
+from .fields import P as P_INT, BLS_X
+
+X_ABS = -BLS_X  # 0xd201000000010000
+
+# MSB-first bit arrays (static scan inputs).
+X_BITS_FULL = np.array([int(b) for b in bin(X_ABS)[2:]], dtype=np.int32)
+X_BITS_MILLER = X_BITS_FULL[1:]                      # implicit leading 1
+P_MINUS_2_BITS = np.array([int(b) for b in bin(P_INT - 2)[2:]], dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Batched field inversion (Fermat ladders) and Fq12 tower inversion
+# ---------------------------------------------------------------------------
+
+def fq_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Batched a^(p-2) over (..., 26) Montgomery limbs; inv(0) = 0."""
+    one = jnp.broadcast_to(jnp.asarray(LF.ONE_MONT), a.shape)
+
+    def body(acc, bit):
+        acc = LF.mont_mul(acc, acc)
+        return LF.select(bit.astype(bool), LF.mont_mul(acc, a), acc), None
+
+    # MSB-first square-and-multiply needs R·a at "multiply" steps because
+    # mont_mul divides by R: track acc in the Montgomery domain throughout
+    # (a already is), so acc stays a Montgomery residue of a^k. Start from
+    # Montgomery one.
+    acc, _ = jax.lax.scan(body, one, jnp.asarray(P_MINUS_2_BITS))
+    return acc
+
+
+def fq2_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """(a0 + a1·u)^-1 = conj(a) / (a0² + a1²), batched over (..., 2, 26)."""
+    n = LF.add(LF.mont_mul(a[..., 0, :], a[..., 0, :]),
+               LF.mont_mul(a[..., 1, :], a[..., 1, :]))
+    ninv = fq_inv(n)
+    return jnp.stack([LF.mont_mul(a[..., 0, :], ninv),
+                      LF.mont_mul(LF.neg(a[..., 1, :]), ninv)], axis=-2)
+
+
+def fq6_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Standard Fq6 = Fq2[v]/(v³-ξ) inversion, batched (..., 3, 2, 26)."""
+    a0, a1, a2 = (a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :])
+    p = T.fq2_mul(
+        jnp.stack([a0, a1, a2, a1, a0, a0], axis=-3),
+        jnp.stack([a0, a2, a2, a1, a1, a2], axis=-3))
+    a00, a12, a22, a11, a01, a02 = [p[..., i, :, :] for i in range(6)]
+    c0 = T.sub(a00, T.fq2_mul_by_xi(a12))
+    c1 = T.sub(T.fq2_mul_by_xi(a22), a01)
+    c2 = T.sub(a11, a02)
+    q = T.fq2_mul(
+        jnp.stack([a0, a2, a1], axis=-3),
+        jnp.stack([c0, c1, c2], axis=-3))
+    n = T.add(q[..., 0, :, :],
+              T.fq2_mul_by_xi(T.add(q[..., 1, :, :], q[..., 2, :, :])))
+    ninv = fq2_inv(n)
+    return T.fq2_mul(jnp.stack([c0, c1, c2], axis=-3), ninv[..., None, :, :])
+
+
+def fq12_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """(a0 + a1·w)^-1 = (a0 - a1·w) / (a0² - v·a1²), batched."""
+    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
+    s = T.fq6_mul(jnp.stack([a0, a1], axis=-4), jnp.stack([a0, a1], axis=-4))
+    n = T.sub(s[..., 0, :, :, :], T.fq6_mul_by_v(s[..., 1, :, :, :]))
+    ninv = fq6_inv(n)
+    return jnp.stack([T.fq6_mul(a0, ninv),
+                      T.fq6_mul(T.neg(a1), ninv)], axis=-4)
+
+
+# ---------------------------------------------------------------------------
+# Frobenius: diagonal multipliers on the v^j·w^i basis
+# ---------------------------------------------------------------------------
+
+def _frobenius_tables():
+    """γn[i][j] ∈ Fq2 with frob^n(Σ c_ij v^j w^i) = Σ conj^n(c_ij)·γn_ij v^j w^i.
+
+    Derived by applying the host oracle's frobenius to basis elements and
+    asserting diagonality — no transcribed constants to get wrong.
+    """
+    tables = {}
+    for n in (1, 2, 3):
+        gam = np.zeros((2, 3, 2, LF.LIMBS), dtype=np.uint32)
+        for i in range(2):
+            for j in range(3):
+                c6 = [list(F.FQ6_ZERO) for _ in range(2)]
+                c6[i][j] = F.FQ2_ONE
+                basis = (tuple(c6[0]), tuple(c6[1]))
+                out = F.fq12_frobenius(basis, n)
+                for ii in range(2):
+                    for jj in range(3):
+                        if (ii, jj) != (i, j):
+                            assert out[ii][jj] == F.FQ2_ZERO
+                gam[i, j] = T.fq2_to_limbs(out[i][j])
+        tables[n] = jnp.asarray(gam)
+    return tables
+
+
+_GAMMA = _frobenius_tables()
+
+
+def fq12_frobenius(a: jnp.ndarray, n: int) -> jnp.ndarray:
+    """frob^n for n ∈ {1,2,3}, batched (..., 2, 3, 2, 26)."""
+    if n % 2:
+        a = jnp.stack([a[..., 0, :], LF.neg(a[..., 1, :])], axis=-2)
+    return T.fq2_mul(a, _GAMMA[n])
+
+
+# ---------------------------------------------------------------------------
+# Sparse line ↔ Fq12
+# ---------------------------------------------------------------------------
+
+def _line_to_fq12(A: jnp.ndarray, B: jnp.ndarray, C: jnp.ndarray) -> jnp.ndarray:
+    """(A + B·v + C·v·w) with A,B,C ∈ Fq2 of shape (..., 2, 26)."""
+    zero = jnp.zeros_like(A)
+    c0 = jnp.stack([A, B, zero], axis=-3)
+    c1 = jnp.stack([zero, C, zero], axis=-3)
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def _fq2_mul_fq(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Fq2 (..., 2, 26) × Fq scalar (..., 26) — coefficient-wise."""
+    return LF.mont_mul(a, s[..., None, :])
+
+
+# ---------------------------------------------------------------------------
+# Miller loop (batched, scanned)
+# ---------------------------------------------------------------------------
+
+def _dbl_step(Tp: jnp.ndarray, xP: jnp.ndarray, yP: jnp.ndarray):
+    """Line l_{T,T}(P)·w³·(2YZ²) and T' = 2T.  T homogeneous projective G2.
+
+    With λ = 3x²/2y:  A = λx−y, B = −λ·xP, C = yP; scaled by 2YZ²:
+        A' = 3X³ − 2Y²Z,  B' = −3X²Z·xP,  C' = 2YZ²·yP.
+    """
+    X, Y, Z = LC.G2_OPS.coords(Tp)
+    r = T.fq2_mul(
+        jnp.stack([X, Y, Z], axis=-3),
+        jnp.stack([X, Y, Z], axis=-3))
+    XX, YY, ZZ = r[..., 0, :, :], r[..., 1, :, :], r[..., 2, :, :]
+    r2 = T.fq2_mul(
+        jnp.stack([X, YY, XX, Y], axis=-3),
+        jnp.stack([XX, Z, Z, ZZ], axis=-3))
+    X3, Y2Z, X2Z, YZ2 = (r2[..., 0, :, :], r2[..., 1, :, :],
+                         r2[..., 2, :, :], r2[..., 3, :, :])
+    A = T.sub(LF.muls(X3, 3), LF.muls(Y2Z, 2))
+    B = T.neg(_fq2_mul_fq(LF.muls(X2Z, 3), xP))
+    C = _fq2_mul_fq(LF.muls(YZ2, 2), yP)
+    return _line_to_fq12(A, B, C), LC.point_add(LC.G2_OPS, Tp, Tp)
+
+
+def _add_step(Tp: jnp.ndarray, Q: jnp.ndarray, Qx: jnp.ndarray,
+              Qy: jnp.ndarray, xP: jnp.ndarray, yP: jnp.ndarray):
+    """Chord l_{T,Q}(P)·w³·D and T' = T + Q (Q affine, lifted in ``Q``).
+
+    λ = N/D with N = y₂Z − Y, D = x₂Z − X:
+        A' = N·x₂ − y₂·D,  B' = −N·xP,  C' = D·yP.
+    """
+    X, Y, Z = LC.G2_OPS.coords(Tp)
+    r = T.fq2_mul(
+        jnp.stack([Qy, Qx], axis=-3),
+        jnp.stack([Z, Z], axis=-3))
+    N = T.sub(r[..., 0, :, :], Y)
+    D = T.sub(r[..., 1, :, :], X)
+    r2 = T.fq2_mul(
+        jnp.stack([N, Qy], axis=-3),
+        jnp.stack([Qx, D], axis=-3))
+    A = T.sub(r2[..., 0, :, :], r2[..., 1, :, :])
+    B = T.neg(_fq2_mul_fq(N, xP))
+    C = _fq2_mul_fq(D, yP)
+    return _line_to_fq12(A, B, C), LC.point_add(LC.G2_OPS, Tp, Q)
+
+
+def miller_loop(g1_affine: jnp.ndarray, g2_affine: jnp.ndarray) -> jnp.ndarray:
+    """Batched f_{|x|,Q}(P), conjugated for x<0 — matches the oracle's
+    :func:`..pairing.miller_loop` up to subfield scalings killed by the
+    final exponentiation.
+
+    ``g1_affine``: (..., 2, 26) Fq pairs (xP, yP); ``g2_affine``:
+    (..., 2, 2, 26) Fq2 pairs (xQ, yQ).  Lanes must be non-infinity (mask
+    garbage lanes downstream).  Returns (..., 2, 3, 2, 26) Fq12.
+    """
+    xP = g1_affine[..., 0, :]
+    yP = g1_affine[..., 1, :]
+    Qx = g2_affine[..., 0, :, :]
+    Qy = g2_affine[..., 1, :, :]
+    one2 = jnp.broadcast_to(
+        jnp.stack([jnp.asarray(LF.ONE_MONT),
+                   jnp.zeros(LF.LIMBS, jnp.uint32)]), Qx.shape)
+    Q = LC.G2_OPS.point(Qx, Qy, one2)
+    batch = xP.shape[:-1]
+    f0 = jnp.broadcast_to(jnp.asarray(T.FQ12_ONE_LIMBS),
+                          batch + (2, 3, 2, LF.LIMBS))
+    Tp0 = Q
+
+    def body(carry, bit):
+        f, Tp = carry
+        l_dbl, T2 = _dbl_step(Tp, xP, yP)
+        f = T.fq12_mul(T.fq12_sqr(f), l_dbl)
+        l_add, T3 = _add_step(T2, Q, Qx, Qy, xP, yP)
+        take = bit.astype(bool)
+        f = jnp.where(take, T.fq12_mul(f, l_add), f)
+        Tp = jnp.where(take, T3, T2)
+        return (f, Tp), None
+
+    (f, _), _ = jax.lax.scan(body, (f0, Tp0), jnp.asarray(X_BITS_MILLER))
+    return T.fq12_conj(f)  # x < 0
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation (cubed), x-power ladder
+# ---------------------------------------------------------------------------
+
+def _pow_x_abs(f: jnp.ndarray) -> jnp.ndarray:
+    """f^|x| by scanned square-and-multiply (64 static bits)."""
+    one = jnp.broadcast_to(jnp.asarray(T.FQ12_ONE_LIMBS), f.shape)
+
+    def body(acc, bit):
+        acc = T.fq12_sqr(acc)
+        return jnp.where(bit.astype(bool), T.fq12_mul(acc, f), acc), None
+
+    acc, _ = jax.lax.scan(body, one, jnp.asarray(X_BITS_FULL))
+    return acc
+
+
+def _pow_u(f: jnp.ndarray) -> jnp.ndarray:
+    """f^u for the (negative) BLS parameter u — cyclotomic elements only
+    (inverse = conjugate)."""
+    return T.fq12_conj(_pow_x_abs(f))
+
+
+def final_exponentiation_cubed(f: jnp.ndarray) -> jnp.ndarray:
+    """f^(3·(q¹²−1)/r): easy part, then HHT hard part ×3 (docstring above)."""
+    # Easy: f^(q⁶−1) then ^(q²+1).
+    m = T.fq12_mul(T.fq12_conj(f), fq12_inv(f))
+    m = T.fq12_mul(fq12_frobenius(m, 2), m)
+    # Hard ×3: (u−1)²·(u+p)·(u²+p²−1) + 3.
+    m1 = T.fq12_mul(_pow_u(m), T.fq12_conj(m))            # m^(u−1)
+    k2 = T.fq12_mul(_pow_u(m1), T.fq12_conj(m1))          # ^(u−1)
+    k3 = T.fq12_mul(_pow_u(k2), fq12_frobenius(k2, 1))    # ^(u+p)
+    k4 = T.fq12_mul(T.fq12_mul(_pow_u(_pow_u(k3)), fq12_frobenius(k3, 2)),
+                    T.fq12_conj(k3))                      # ^(u²+p²−1)
+    return T.fq12_mul(k4, T.fq12_mul(T.fq12_sqr(m), m))
+
+
+def fq12_is_one(f: jnp.ndarray) -> jnp.ndarray:
+    """Batched f == 1 (lazy-representation aware)."""
+    d = LF.sub(f, jnp.asarray(T.FQ12_ONE_LIMBS))
+    z = LF.is_zero(d)  # (..., 2, 3, 2)
+    return jnp.all(z, axis=(-3, -2, -1))
+
+
+# ---------------------------------------------------------------------------
+# Affine conversion + fused multi-pairing check
+# ---------------------------------------------------------------------------
+
+def g1_proj_to_affine(p: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3, 26) projective → (..., 2, 26) affine; identity → (0, 0)."""
+    X, Y, Z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    zi = fq_inv(Z)
+    return jnp.stack([LF.mont_mul(X, zi), LF.mont_mul(Y, zi)], axis=-2)
+
+
+def g2_proj_to_affine(p: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3, 2, 26) projective → (..., 2, 2, 26) affine; identity → 0."""
+    X, Y, Z = p[..., 0, :, :], p[..., 1, :, :], p[..., 2, :, :]
+    zi = fq2_inv(Z)
+    return jnp.stack([T.fq2_mul(X, zi), T.fq2_mul(Y, zi)], axis=-3)
+
+
+def _product_reduce(f: jnp.ndarray) -> jnp.ndarray:
+    """Tree-product over the lane axis (len must be a power of two)."""
+    n = f.shape[0]
+    if n & (n - 1):
+        raise ValueError("pad pairing lanes to a power of two")
+    while n > 1:
+        n //= 2
+        f = T.fq12_mul(f[:n], f[n:2 * n])
+    return f[0]
+
+
+def multi_pairing_is_one(g1_proj: jnp.ndarray, g2_proj: jnp.ndarray,
+                         mask: jnp.ndarray) -> jnp.ndarray:
+    """∏_{i: mask_i} e(P_i, Q_i) == 1, fused on device.
+
+    ``g1_proj``: (B, 3, 26); ``g2_proj``: (B, 3, 2, 26); ``mask``: (B,) bool.
+    B must be a power of two.  Lanes where either point is the identity
+    contribute 1 (e(O, ·) = e(·, O) = 1), as do masked padding lanes.
+    """
+    g1_aff = g1_proj_to_affine(g1_proj)
+    g2_aff = g2_proj_to_affine(g2_proj)
+    f = miller_loop(g1_aff, g2_aff)
+    live = (mask
+            & ~LF.is_zero(g1_proj[..., 2, :])
+            & ~T.fq2_is_zero(g2_proj[..., 2, :, :]))
+    one = jnp.asarray(T.FQ12_ONE_LIMBS)
+    f = jnp.where(live[:, None, None, None, None], f, one)
+    prod = _product_reduce(f)
+    return fq12_is_one(final_exponentiation_cubed(prod))

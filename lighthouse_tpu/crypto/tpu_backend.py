@@ -1,0 +1,184 @@
+"""The ``tpu`` BLS backend — batched signature verification on the device.
+
+This is the role blst plays for the reference (``bls::impls::supranational``,
+``/root/reference/crypto/bls/src/impls/blst.rs``): the production backend
+behind the backend-registry seam in :mod:`.bls`.  All three public verify
+entry points funnel into ONE fused device program per (sets, keys) shape
+bucket:
+
+    per-set pubkey tree-aggregation (G1)
+      → per-set random-linear-combination scaling (64-bit ladders, G1+G2)
+      → signature accumulation (G2 tree sum)
+      → batched Miller loops over all pairs
+      → one shared final exponentiation of the lane product
+      → == 1
+
+replicating ``verify_multiple_aggregate_signatures`` semantics
+(``impls/blst.rs:36-119``) including the consensus-critical edge rules:
+empty set lists, empty signing-key lists, missing/infinity signatures and
+identity aggregate pubkeys all fail verification (host-side pre-checks +
+an on-device identity-aggregate flag).
+
+Host work is marshalling only: affine points → Montgomery limb arrays
+(memoised per point, the ``validator_pubkey_cache.rs`` role) and
+hash-to-curve of messages (host SSWU for now).  Shapes are bucketed to
+powers of two so XLA compiles a handful of programs, then every call hits
+the jit cache.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import curve as C
+from . import limb_curve as LC
+from . import limb_field as LF
+from . import limb_pairing as LP
+from ..ops.merkle import _next_pow2
+from .hash_to_curve import hash_to_g2
+
+_NEG_G1_GEN = LC.g1_to_limbs(C.g1_neg(C.G1_GEN))
+_G1_IDENT = LC.g1_to_limbs(None)
+_G2_IDENT = LC.g2_to_limbs(None)
+
+
+@lru_cache(maxsize=1 << 16)
+def _g1_limbs(point) -> bytes:
+    return LC.g1_to_limbs(point).tobytes()
+
+
+@lru_cache(maxsize=1 << 16)
+def _g2_limbs(point) -> bytes:
+    return LC.g2_to_limbs(point).tobytes()
+
+
+@lru_cache(maxsize=1 << 14)
+def _h_limbs(message: bytes) -> bytes:
+    return LC.g2_to_limbs(hash_to_g2(message)).tobytes()
+
+
+def _g1_arr(point) -> np.ndarray:
+    return np.frombuffer(_g1_limbs(point), np.uint32).reshape(3, LF.LIMBS)
+
+
+def _g2_arr(point) -> np.ndarray:
+    return np.frombuffer(_g2_limbs(point), np.uint32).reshape(3, 2, LF.LIMBS)
+
+
+def _h_arr(message: bytes) -> np.ndarray:
+    return np.frombuffer(_h_limbs(bytes(message)), np.uint32).reshape(3, 2, LF.LIMBS)
+
+
+@jax.jit
+def _verify_sets_kernel(pk, kmask, sig, h, scal, smask):
+    """Fused batch verify.  Shapes: pk (S,K,3,26), kmask (S,K) bool,
+    sig/h (S,3,2,26) projective, scal (S,2) uint32 lo/hi, smask (S,) bool.
+    S and K are powers of two.  Returns a scalar bool."""
+    S, K = pk.shape[0], pk.shape[1]
+    ident1 = jnp.asarray(_G1_IDENT)
+    pkm = LC.point_select(kmask, pk, ident1, LC.G1_OPS)
+    agg = LC.tree_sum(LC.G1_OPS, pkm, K)              # (S,3,26)
+    # A live set whose aggregate pubkey is the identity is invalid
+    # (`PythonBackend.verify_signature_sets` / blst's aggregate move).
+    any_bad = jnp.any(smask & LF.is_zero(agg[..., 2, :]))
+    aggc = LC.scalar_mul(LC.G1_OPS, agg, scal)        # (S,3,26)
+    sigc = LC.scalar_mul(LC.G2_OPS, sig, scal)        # (S,3,2,26)
+    sigsum = LC.tree_sum(LC.G2_OPS, sigc, S)          # (3,2,26)
+    # Pairing lanes: i<S → (c_i·aggpk_i, H_i); lane S → (−g1, Σc_i·sig_i);
+    # the rest of the 2S block is masked padding.
+    g1_lanes = jnp.concatenate(
+        [aggc, jnp.asarray(_NEG_G1_GEN)[None],
+         jnp.broadcast_to(jnp.asarray(_G1_IDENT), (S - 1, 3, LF.LIMBS))])
+    g2_lanes = jnp.concatenate(
+        [h, sigsum[None],
+         jnp.broadcast_to(jnp.asarray(_G2_IDENT), (S - 1, 3, 2, LF.LIMBS))])
+    lane_mask = jnp.concatenate(
+        [smask, jnp.array([True]), jnp.zeros(S - 1, bool)])
+    ok = LP.multi_pairing_is_one(g1_lanes, g2_lanes, lane_mask)
+    return ok & ~any_bad
+
+
+def _dispatch(entries, rand_fn) -> bool:
+    """entries: list of (agg_sig_point | None meaning infinity is already
+    rejected, [pubkey points], message bytes).  rand_fn() → 64-bit scalar."""
+    S = _next_pow2(len(entries))
+    K = _next_pow2(max(len(e[1]) for e in entries))
+    pk = np.broadcast_to(_G1_IDENT, (S, K, 3, LF.LIMBS)).copy()
+    kmask = np.zeros((S, K), bool)
+    sig = np.broadcast_to(_G2_IDENT, (S, 3, 2, LF.LIMBS)).copy()
+    h = np.broadcast_to(_G2_IDENT, (S, 3, 2, LF.LIMBS)).copy()
+    scal = np.zeros((S, 2), np.uint32)
+    smask = np.zeros(S, bool)
+    for i, (sig_pt, keys, msg) in enumerate(entries):
+        for j, kp in enumerate(keys):
+            pk[i, j] = _g1_arr(kp)
+        kmask[i, :len(keys)] = True
+        if sig_pt is not None:
+            sig[i] = _g2_arr(sig_pt)
+        h[i] = _h_arr(msg)
+        c = rand_fn()
+        scal[i] = (c & 0xFFFFFFFF, c >> 32)
+        smask[i] = True
+    ok = _verify_sets_kernel(jnp.asarray(pk), jnp.asarray(kmask),
+                             jnp.asarray(sig), jnp.asarray(h),
+                             jnp.asarray(scal), jnp.asarray(smask))
+    return bool(ok)
+
+
+class TpuBackend:
+    """Device-batched verification registered as ``tpu`` in :mod:`.bls`."""
+
+    name = "tpu"
+
+    def verify(self, signature, pubkeys, message) -> bool:
+        if signature.point is None or not pubkeys:
+            return False
+        return _dispatch(
+            [(signature.point, [k.point for k in pubkeys], bytes(message))],
+            rand_fn=lambda: 1)
+
+    def aggregate_verify(self, signature, pubkeys, messages) -> bool:
+        if signature.point is None or not pubkeys \
+                or len(pubkeys) != len(messages):
+            return False
+        # Distinct message per signer: one single-key set per message, the
+        # aggregate signature attached to the first set, scalars all 1.
+        entries = [(None, [pk.point], bytes(m))
+                   for pk, m in zip(pubkeys, messages)]
+        entries[0] = (signature.point, entries[0][1], entries[0][2])
+        return _dispatch(entries, rand_fn=lambda: 1)
+
+    def verify_signature_sets(self, sets) -> bool:
+        import secrets
+        if not sets:
+            return False
+        entries = []
+        for s in sets:
+            if s.signature is None or s.signature.point is None:
+                return False
+            if not s.signing_keys:
+                return False
+            entries.append((s.signature.point,
+                            [k.point for k in s.signing_keys],
+                            bytes(s.message)))
+
+        def rand_nonzero():
+            c = 0
+            while c == 0:
+                c = secrets.randbits(64)
+            return c
+
+        return _dispatch(entries, rand_fn=rand_nonzero)
+
+
+def register() -> None:
+    from . import bls
+    bls.register_backend("tpu", TpuBackend())
+
+
+register()

@@ -1,0 +1,179 @@
+"""Device pairing vs the host oracle.
+
+Fast tests cover the pieces with small compile footprints (tower
+inversions, Frobenius, is_one).  The full Miller-loop/final-exponentiation
+stack and the ``tpu`` BLS backend are exercised under ``@slow`` (the
+63-iteration scan takes minutes of XLA CPU compile on a cold cache —
+conftest enables the persistent compilation cache so later runs are cheap).
+
+Run the slow set with:  LTPU_SLOW=1 python -m pytest tests/test_limb_pairing.py
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto import curve as C
+from lighthouse_tpu.crypto import fields as F
+from lighthouse_tpu.crypto import limb_curve as LC
+from lighthouse_tpu.crypto import limb_field as LF
+from lighthouse_tpu.crypto import limb_pairing as LP
+from lighthouse_tpu.crypto import limb_tower as T
+from lighthouse_tpu.crypto import pairing as HP
+
+slow = pytest.mark.skipif(not os.environ.get("LTPU_SLOW"),
+                          reason="set LTPU_SLOW=1 (scan compiles are minutes cold)")
+
+RNG = np.random.default_rng(23)
+
+
+def _rand_fq() -> int:
+    return int.from_bytes(RNG.bytes(48), "big") % F.P
+
+
+def _rand_fq12():
+    return tuple(tuple(tuple(_rand_fq() for _ in range(2)) for _ in range(3))
+                 for _ in range(2))
+
+
+def test_fq_inv_matches_host():
+    xs = [_rand_fq() for _ in range(4)] + [1]
+    limbs = jnp.asarray(np.stack([LF.to_mont(x) for x in xs]))
+    out = LP.fq_inv(limbs)
+    for i, x in enumerate(xs):
+        assert LF.from_mont(np.asarray(out[i])) == pow(x, -1, F.P)
+
+
+def test_fq_inv_zero_gives_zero():
+    out = LP.fq_inv(jnp.asarray(LF.to_mont(0))[None])
+    assert LF.from_mont(np.asarray(out[0])) == 0
+
+
+def test_fq2_fq6_fq12_inv_match_host():
+    a12 = _rand_fq12()
+    a2 = a12[0][0]
+    a6 = a12[1]
+    d2 = LP.fq2_inv(jnp.asarray(T.fq2_to_limbs(a2)))
+    assert T.fq2_from_limbs(np.asarray(d2)) == F.fq2_inv(a2)
+    d6 = LP.fq6_inv(jnp.asarray(T.fq6_to_limbs(a6)))
+    assert T.fq6_from_limbs(np.asarray(d6)) == F.fq6_inv(a6)
+    d12 = LP.fq12_inv(jnp.asarray(T.fq12_to_limbs(a12)))
+    assert T.fq12_from_limbs(np.asarray(d12)) == F.fq12_inv(a12)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_frobenius_matches_host(n):
+    a = _rand_fq12()
+    dev = LP.fq12_frobenius(jnp.asarray(T.fq12_to_limbs(a)), n)
+    assert T.fq12_from_limbs(np.asarray(dev)) == F.fq12_frobenius(a, n)
+
+
+def test_fq12_is_one():
+    one = jnp.asarray(T.FQ12_ONE_LIMBS)
+    assert bool(LP.fq12_is_one(one))
+    a = jnp.asarray(T.fq12_to_limbs(_rand_fq12()))
+    assert not bool(LP.fq12_is_one(a))
+    # A lazy representative of 1 (coefficients shifted by N) still reads 1.
+    lazy = LF.add(one, jnp.zeros_like(one))
+    assert bool(LP.fq12_is_one(lazy))
+
+
+def test_hard_part_decomposition_identity():
+    """The exponent identity behind final_exponentiation_cubed, exactly."""
+    u = F.BLS_X
+    hard = (F.P ** 4 - F.P ** 2 + 1) // F.R
+    assert 3 * hard == (u - 1) ** 2 * (u + F.P) * (u ** 2 + F.P ** 2 - 1) + 3
+
+
+def test_proj_to_affine_roundtrip():
+    pts = [C.g1_mul(C.G1_GEN, 7), C.g1_mul(C.G1_GEN, 9), None]
+    proj = jnp.asarray(np.stack([LC.g1_to_limbs(p) for p in pts]))
+    aff = LP.g1_proj_to_affine(proj)
+    for i, p in enumerate(pts):
+        if p is None:
+            assert LF.from_mont(np.asarray(aff[i, 0])) == 0
+        else:
+            assert LF.from_mont(np.asarray(aff[i, 0])) == p[0]
+            assert LF.from_mont(np.asarray(aff[i, 1])) == p[1]
+
+
+# ---------------------------------------------------------------------------
+# Full-stack (slow: Miller scan + final-exp ladders)
+# ---------------------------------------------------------------------------
+
+@slow
+def test_pairing_matches_host_cubed():
+    p1 = C.g1_mul(C.G1_GEN, 12345)
+    q1 = C.g2_mul(C.G2_GEN, 67890)
+    host = F.fq12_pow(HP.pairing(p1, q1), 3)
+    aff1 = LP.g1_proj_to_affine(jnp.asarray(LC.g1_to_limbs(p1))[None])
+    aff2 = LP.g2_proj_to_affine(jnp.asarray(LC.g2_to_limbs(q1))[None])
+    f = LP.miller_loop(aff1, aff2)
+    dev = LP.final_exponentiation_cubed(f[0])
+    assert T.fq12_from_limbs(np.asarray(dev)) == host
+
+
+@slow
+def test_multi_pairing_bilinearity_and_mask():
+    a, b = 1111, 2222
+    pa = C.g1_mul(C.G1_GEN, a)
+    qb = C.g2_mul(C.G2_GEN, b)
+    pn = C.g1_neg(C.g1_mul(C.G1_GEN, a * b))
+    g1 = jnp.asarray(np.stack([LC.g1_to_limbs(pa), LC.g1_to_limbs(pn)]))
+    g2 = jnp.asarray(np.stack([LC.g2_to_limbs(qb), LC.g2_to_limbs(C.G2_GEN)]))
+    assert bool(LP.multi_pairing_is_one(g1, g2, jnp.array([True, True])))
+    # Drop one factor → product ≠ 1.
+    assert not bool(LP.multi_pairing_is_one(g1, g2, jnp.array([True, False])))
+    # Identity lanes contribute 1: replace lane 0 with (O, Q).
+    g1_id = g1.at[0].set(jnp.asarray(LC.g1_to_limbs(None)))
+    assert not bool(LP.multi_pairing_is_one(g1_id, g2, jnp.array([True, True])))
+
+
+@slow
+def test_tpu_backend_matches_python_backend():
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto import tpu_backend  # noqa: F401 (registers)
+
+    sks = [bls.SecretKey(1000 + i) for i in range(4)]
+    pks = [k.public_key() for k in sks]
+    msg_a, msg_b = b"message-a", b"message-b"
+
+    tpu = bls._BACKENDS["tpu"]
+
+    # Single verify.
+    sig = sks[0].sign(msg_a)
+    assert tpu.verify(sig, [pks[0]], msg_a)
+    assert not tpu.verify(sig, [pks[0]], msg_b)
+    assert not tpu.verify(sig, [pks[1]], msg_a)
+
+    # fast_aggregate_verify-shaped: one message, many signers.
+    agg = bls.aggregate_signatures([k.sign(msg_a) for k in sks])
+    assert tpu.verify(agg, pks, msg_a)
+    assert not tpu.verify(agg, pks[:3], msg_a)
+
+    # aggregate_verify: distinct messages.
+    agg2 = bls.aggregate_signatures([sks[0].sign(msg_a), sks[1].sign(msg_b)])
+    assert tpu.aggregate_verify(agg2, [pks[0], pks[1]], [msg_a, msg_b])
+    assert not tpu.aggregate_verify(agg2, [pks[1], pks[0]], [msg_a, msg_b])
+
+    # RLC batch of sets, one valid + tamper rejection.
+    sets = [
+        bls.SignatureSet(agg, list(pks), msg_a),
+        bls.SignatureSet(sks[2].sign(msg_b), [pks[2]], msg_b),
+        bls.SignatureSet(sks[3].sign(msg_b), [pks[3]], msg_b),
+    ]
+    assert tpu.verify_signature_sets(sets)
+    bad = sets[:2] + [bls.SignatureSet(sks[3].sign(msg_b), [pks[0]], msg_b)]
+    assert not bad[2].signature is None
+    assert not tpu.verify_signature_sets(bad)
+    # Identity-aggregate rule: pk + (-pk) sums to O → invalid.
+    neg_pk = bls.PublicKey(C.g1_neg(pks[0].point))
+    sets_id = [bls.SignatureSet(agg, [pks[0], neg_pk], msg_a)]
+    assert not tpu.verify_signature_sets(sets_id)
+    # Edge rules shared with the python backend.
+    assert not tpu.verify_signature_sets([])
+    assert not tpu.verify_signature_sets(
+        [bls.SignatureSet(bls.Signature(None), [pks[0]], msg_a)])
+    assert not tpu.verify_signature_sets([bls.SignatureSet(agg, [], msg_a)])
