@@ -55,14 +55,14 @@ def interop_genesis_state(n_validators: int, genesis_time: int, preset, spec,
     reg._n = n_validators
     for i in range(n_validators):
         pk = interop_pubkey(i)
-        reg.pubkey[i] = np.frombuffer(pk, dtype=np.uint8)
-        reg.withdrawal_credentials[i] = np.frombuffer(
+        reg.wcol("pubkey")[i] = np.frombuffer(pk, dtype=np.uint8)
+        reg.wcol("withdrawal_credentials")[i] = np.frombuffer(
             bls_withdrawal_credentials(pk), dtype=np.uint8)
-    reg.effective_balance[:n_validators] = preset.MAX_EFFECTIVE_BALANCE
-    reg.activation_eligibility_epoch[:n_validators] = GENESIS_EPOCH
-    reg.activation_epoch[:n_validators] = GENESIS_EPOCH
-    reg.exit_epoch[:n_validators] = FAR_FUTURE_EPOCH
-    reg.withdrawable_epoch[:n_validators] = FAR_FUTURE_EPOCH
+    reg.wcol("effective_balance")[:] = preset.MAX_EFFECTIVE_BALANCE
+    reg.wcol("activation_eligibility_epoch")[:] = GENESIS_EPOCH
+    reg.wcol("activation_epoch")[:] = GENESIS_EPOCH
+    reg.wcol("exit_epoch")[:] = FAR_FUTURE_EPOCH
+    reg.wcol("withdrawable_epoch")[:] = FAR_FUTURE_EPOCH
 
     scls = T.state_cls(fork)
     state = scls()

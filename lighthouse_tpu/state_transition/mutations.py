@@ -39,8 +39,8 @@ def initiate_validator_exit(state, index: int, preset, spec) -> None:
     exit_queue_churn = int((pending == np.uint64(exit_queue_epoch)).sum())
     if exit_queue_churn >= get_validator_churn_limit(state, preset, spec):
         exit_queue_epoch += 1
-    reg.col("exit_epoch")[index] = exit_queue_epoch
-    reg.col("withdrawable_epoch")[index] = (
+    reg.wcol("exit_epoch")[index] = exit_queue_epoch
+    reg.wcol("withdrawable_epoch")[index] = (
         exit_queue_epoch + spec.min_validator_withdrawability_delay)
 
 
@@ -69,8 +69,8 @@ def slash_validator(state, slashed_index: int, fork: ForkName, preset, spec,
     epoch = current_epoch(state, preset)
     initiate_validator_exit(state, slashed_index, preset, spec)
     reg = state.validators
-    reg.col("slashed")[slashed_index] = True
-    reg.col("withdrawable_epoch")[slashed_index] = max(
+    reg.wcol("slashed")[slashed_index] = True
+    reg.wcol("withdrawable_epoch")[slashed_index] = max(
         int(reg.col("withdrawable_epoch")[slashed_index]),
         epoch + preset.EPOCHS_PER_SLASHINGS_VECTOR)
     eff = int(reg.col("effective_balance")[slashed_index])

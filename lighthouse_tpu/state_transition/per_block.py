@@ -455,7 +455,7 @@ def process_bls_to_execution_change(state, signed_change, spec, acc) -> None:
         state, signed_change, spec.genesis_fork_version, None))
     new = (ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11
            + change.to_execution_address)
-    state.validators.col("withdrawal_credentials")[idx] = np.frombuffer(
+    state.validators.wcol("withdrawal_credentials")[idx] = np.frombuffer(
         new, dtype=np.uint8)
 
 

@@ -253,7 +253,7 @@ def process_registry_updates(state, preset, spec,
                  == np.uint64(FAR_FUTURE_EPOCH))
                 & (reg.col("effective_balance")
                    == np.uint64(preset.MAX_EFFECTIVE_BALANCE)))
-    reg.col("activation_eligibility_epoch")[eligible] = cur + 1
+    reg.wcol("activation_eligibility_epoch")[eligible] = cur + 1
 
     # Ejections — sequential: each consumes exit churn.
     eject = (is_active_at(reg, cur)
@@ -275,7 +275,7 @@ def process_registry_updates(state, preset, spec,
     from .helpers import get_validator_churn_limit
     churn = get_validator_churn_limit(state, preset, spec)
     dequeued = queue[:churn]
-    reg.col("activation_epoch")[dequeued] = compute_activation_exit_epoch(
+    reg.wcol("activation_epoch")[dequeued] = compute_activation_exit_epoch(
         cur, preset.MAX_SEED_LOOKAHEAD)
     summary.activated += len(dequeued)
 
@@ -322,7 +322,7 @@ def process_effective_balance_updates(state, preset) -> None:
     update = (bal + downward < eff) | (eff + upward < bal)
     new_eff = np.minimum(bal - bal % inc,
                          np.uint64(preset.MAX_EFFECTIVE_BALANCE))
-    reg.col("effective_balance")[update] = new_eff[update]
+    reg.wcol("effective_balance")[update] = new_eff[update]
 
 
 def process_slashings_reset(state, preset) -> None:

@@ -142,6 +142,13 @@ def RootsVector(length: int) -> type:
                 return device_merkle_root(value.words(), cls.LENGTH)
 
             @classmethod
+            def leaf_words(cls, value):
+                """(chunk words, limit_chunks, length mixin) for the
+                incremental hash cache."""
+                value = _as_roots(value)
+                return value.words(), cls.LENGTH, None
+
+            @classmethod
             def default(cls) -> Roots:
                 return Roots.zeros(cls.LENGTH)
 
@@ -183,6 +190,11 @@ def RootsList(limit: int) -> type:
                     raise SszError("roots list exceeds limit")
                 return device_merkle_root(value.words(), cls.LIMIT,
                                           length_mixin=value.shape[0])
+
+            @classmethod
+            def leaf_words(cls, value):
+                value = _as_roots(value)
+                return value.words(), cls.LIMIT, value.shape[0]
 
             @classmethod
             def default(cls) -> Roots:
@@ -262,6 +274,13 @@ def _packed_uint(name: str, dtype, bits: int, bound: int, is_list: bool) -> type
             return device_merkle_root(
                 words, limit_chunks,
                 length_mixin=arr.shape[0] if is_list else None)
+
+        @classmethod
+        def leaf_words(cls, value):
+            arr = cls._as_arr(value)
+            words = bytes_to_chunk_words(
+                arr.astype(arr.dtype.newbyteorder("<"), copy=False).tobytes())
+            return words, limit_chunks, (arr.shape[0] if is_list else None)
 
         @classmethod
         def default(cls) -> np.ndarray:

@@ -299,6 +299,27 @@ class SpecTypes:
         Registry = ValidatorRegistryList(p.VALIDATOR_REGISTRY_LIMIT)
 
         class _StateCommon(Container):
+            """Shared state prefix + the incremental tree-hash cache hook
+            (``BeaconTreeHashCache``,
+            ``types/src/beacon_state/tree_hash_cache.rs:332``): instances
+            carry a :class:`~lighthouse_tpu.types.state_cache.StateHashCache`
+            that makes repeated ``tree_hash_root()`` calls O(changes·log n);
+            ``copy()`` clones it like the reference's state clone."""
+
+            def tree_hash_root(self) -> bytes:
+                from .state_cache import StateHashCache
+                thc = self.__dict__.get("_thc")
+                if thc is None:
+                    thc = self.__dict__["_thc"] = StateHashCache()
+                return thc.root(self)
+
+            def copy(self):
+                out = super().copy()
+                thc = self.__dict__.get("_thc")
+                if thc is not None:
+                    out.__dict__["_thc"] = thc.copy()
+                return out
+
             genesis_time: uint64
             genesis_validators_root: Bytes32
             slot: uint64

@@ -1,0 +1,138 @@
+"""BeaconState incremental tree-hash cache.
+
+Counterpart of ``BeaconTreeHashCache``
+(``/root/reference/consensus/types/src/beacon_state/tree_hash_cache.rs:332``)
+and its per-field ``cached_tree_hash`` arenas: the state root becomes
+O(changes·log n) instead of O(state).
+
+Three tiers, by field shape:
+
+- **Validator registry** (the 2^40-limit list of 8-field records,
+  the reference's rayon-parallel arena — ``tree_hash_cache.rs:535-556``):
+  dirty records come from the registry's column/row dirty marks (writes go
+  through ``wcol``/``set``); only marked columns are diffed (one vectorized
+  compare), only changed records re-hash their 8-leaf mini-trees (batched),
+  and the big record-root tree updates incrementally.
+- **Columnar packed fields** (balances, participation flags, inactivity
+  scores, roots vectors, slashings): leaf-diff + dirty-path propagation via
+  :class:`~lighthouse_tpu.ops.tree_cache.IncrementalMerkleCache`.
+- **Small fields** (headers, checkpoints, sync committees, vote lists):
+  re-hashed only when their SSZ encoding changes (memoised; the encode-and
+  -compare costs µs, and a SyncCommittee rehash alone is ~1k hashes).
+
+The cache travels with ``BeaconState.copy()`` (levels are copied, like the
+reference's ``BeaconState`` clone-with-cache) and rebuilds transparently if
+absent, so correctness never depends on it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.merkle import merkleize_host
+from ..ops.tree_cache import HASH_COUNT, IncrementalMerkleCache
+
+
+class RegistryCache:
+    """Record-root cache for the SoA validator registry."""
+
+    def __init__(self):
+        self.stored: dict[str, np.ndarray] | None = None  # column copies
+        self.record_roots: np.ndarray | None = None       # (n, 8) u32
+        self.tree: IncrementalMerkleCache | None = None
+
+    def root(self, reg, limit: int) -> bytes:
+        n = len(reg)
+        if self.tree is None:
+            self.tree = IncrementalMerkleCache(limit, mixin_length=True)
+        if self.stored is None or self.record_roots is None \
+                or self.record_roots.shape[0] > n:
+            # Cold start (or shrink, which consensus never does): full build.
+            self.record_roots = reg.record_roots_words()
+            self.stored = {c: np.array(getattr(reg, c)[:n])
+                           for c in reg._COLUMNS}
+        else:
+            old_n = self.record_roots.shape[0]
+            dirty = np.zeros(n, dtype=bool)
+            dirty[old_n:] = True
+            for cname in reg._dirty_cols:
+                col = getattr(reg, cname)[:old_n]
+                st = self.stored[cname][:old_n]
+                if col.ndim == 1:
+                    np.logical_or(dirty[:old_n], col != st, out=dirty[:old_n])
+                else:
+                    np.logical_or(dirty[:old_n], (col != st).any(axis=1),
+                                  out=dirty[:old_n])
+            for r in reg._dirty_rows:
+                if r < n:
+                    dirty[r] = True
+            idx = np.nonzero(dirty)[0]
+            if idx.size:
+                roots = reg.record_roots_words(idx)
+                if n != old_n:
+                    grown = np.zeros((n, 8), dtype=np.uint32)
+                    grown[:old_n] = self.record_roots
+                    self.record_roots = grown
+                self.record_roots[idx] = roots
+                for cname in reg._COLUMNS:
+                    col = getattr(reg, cname)[:n]
+                    st = self.stored[cname]
+                    if st.shape[0] != n:
+                        st = np.array(col)
+                        self.stored[cname] = st
+                    else:
+                        st[idx] = col[idx]
+        reg._dirty_cols.clear()
+        reg._dirty_rows.clear()
+        return self.tree.root_words(self.record_roots, length=n)
+
+    def copy(self) -> "RegistryCache":
+        out = RegistryCache.__new__(RegistryCache)
+        out.stored = (None if self.stored is None
+                      else {k: v.copy() for k, v in self.stored.items()})
+        out.record_roots = (None if self.record_roots is None
+                            else self.record_roots.copy())
+        out.tree = None if self.tree is None else self.tree.copy()
+        return out
+
+
+class StateHashCache:
+    """Per-state-instance cache over all fields + the container fold."""
+
+    def __init__(self):
+        self.fields: dict[str, IncrementalMerkleCache] = {}
+        self.registry = RegistryCache()
+        self.small: dict[str, tuple[bytes, bytes]] = {}  # fname → (enc, root)
+
+    def root(self, state) -> bytes:
+        leaves = []
+        for fname, ftype in type(state).FIELDS.items():
+            v = getattr(state, fname)
+            if fname == "validators":
+                leaves.append(self.registry.root(v, ftype.LIMIT))
+            elif hasattr(ftype, "leaf_words"):
+                words, limit_chunks, length = ftype.leaf_words(v)
+                cache = self.fields.get(fname)
+                if cache is None:
+                    cache = IncrementalMerkleCache(
+                        limit_chunks, mixin_length=length is not None)
+                    self.fields[fname] = cache
+                leaves.append(cache.root_words(words, length))
+            else:
+                enc = ftype.serialize(v)
+                memo = self.small.get(fname)
+                if memo is not None and memo[0] == enc:
+                    leaves.append(memo[1])
+                else:
+                    r = ftype.hash_tree_root(v)
+                    self.small[fname] = (enc, r)
+                    leaves.append(r)
+        HASH_COUNT[0] += len(leaves)  # container fold, ~2 per leaf
+        return merkleize_host(leaves)
+
+    def copy(self) -> "StateHashCache":
+        out = StateHashCache.__new__(StateHashCache)
+        out.fields = {k: c.copy() for k, c in self.fields.items()}
+        out.registry = self.registry.copy()
+        out.small = dict(self.small)
+        return out

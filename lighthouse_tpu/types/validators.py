@@ -86,15 +86,22 @@ class ValidatorRegistry:
     def __init__(self, n: int = 0, _cap: int | None = None):
         cap = max(_cap if _cap is not None else n, n, 8)
         self._n = n
-        self.pubkey = np.zeros((cap, 48), dtype=np.uint8)
-        self.withdrawal_credentials = np.zeros((cap, 32), dtype=np.uint8)
-        self.effective_balance = np.zeros(cap, dtype=np.uint64)
-        self.slashed = np.zeros(cap, dtype=bool)
-        self.activation_eligibility_epoch = np.full(
+        self._pubkey = np.zeros((cap, 48), dtype=np.uint8)
+        self._withdrawal_credentials = np.zeros((cap, 32), dtype=np.uint8)
+        self._effective_balance = np.zeros(cap, dtype=np.uint64)
+        self._slashed = np.zeros(cap, dtype=bool)
+        self._activation_eligibility_epoch = np.full(
             cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
-        self.activation_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
-        self.exit_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
-        self.withdrawable_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+        self._activation_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+        self._exit_epoch = np.full(cap, FAR_FUTURE_EPOCH, dtype=np.uint64)
+        self._withdrawable_epoch = np.full(cap, FAR_FUTURE_EPOCH,
+                                           dtype=np.uint64)
+        # Dirty tracking for the incremental tree-hash cache
+        # (``cached_tree_hash``'s dirty leaves, at column/row granularity).
+        # ``col()`` views are read-only so every write goes through ``wcol``/
+        # ``set``/``append`` and is tracked — an unmarked write raises.
+        self._dirty_cols: set = set(self._COLUMNS)
+        self._dirty_rows: set = set()
 
     _COLUMNS = ("pubkey", "withdrawal_credentials", "effective_balance",
                 "slashed", "activation_eligibility_epoch", "activation_epoch",
@@ -106,22 +113,36 @@ class ValidatorRegistry:
         return self._n
 
     def col(self, name: str) -> np.ndarray:
-        """Live view of a column, truncated to the real length."""
-        return getattr(self, name)[:self._n]
+        """Read-only view of a column, truncated to the real length.
+        Writes must go through :meth:`wcol` (which marks the column dirty
+        for the incremental hash cache) — writing this view raises, and the
+        public column attributes are themselves read-only views so no write
+        can bypass the tracking."""
+        v = getattr(self, "_" + name)[:self._n]
+        v.flags.writeable = False
+        return v
+
+    def wcol(self, name: str) -> np.ndarray:
+        """Writable column view; marks the whole column dirty (the hash
+        cache diffs it against its stored copy at root time, so the cost of
+        a column-wide mark is one vectorized compare, not a rehash)."""
+        self._dirty_cols.add(name)
+        return getattr(self, "_" + name)[:self._n]
 
     def __getitem__(self, i: int) -> Validator:
         if not -self._n <= i < self._n:
             raise IndexError(i)
         i %= max(self._n, 1)
         return Validator(
-            pubkey=self.pubkey[i].tobytes(),
-            withdrawal_credentials=self.withdrawal_credentials[i].tobytes(),
-            effective_balance=int(self.effective_balance[i]),
-            slashed=bool(self.slashed[i]),
-            activation_eligibility_epoch=int(self.activation_eligibility_epoch[i]),
-            activation_epoch=int(self.activation_epoch[i]),
-            exit_epoch=int(self.exit_epoch[i]),
-            withdrawable_epoch=int(self.withdrawable_epoch[i]),
+            pubkey=self._pubkey[i].tobytes(),
+            withdrawal_credentials=self._withdrawal_credentials[i].tobytes(),
+            effective_balance=int(self._effective_balance[i]),
+            slashed=bool(self._slashed[i]),
+            activation_eligibility_epoch=int(
+                self._activation_eligibility_epoch[i]),
+            activation_epoch=int(self._activation_epoch[i]),
+            exit_epoch=int(self._exit_epoch[i]),
+            withdrawable_epoch=int(self._withdrawable_epoch[i]),
         )
 
     def __iter__(self):
@@ -131,30 +152,31 @@ class ValidatorRegistry:
     def set(self, i: int, v: Validator) -> None:
         if not 0 <= i < self._n:
             raise IndexError(i)
-        self.pubkey[i] = np.frombuffer(v.pubkey, dtype=np.uint8)
-        self.withdrawal_credentials[i] = np.frombuffer(
+        self._dirty_rows.add(i)
+        self._pubkey[i] = np.frombuffer(v.pubkey, dtype=np.uint8)
+        self._withdrawal_credentials[i] = np.frombuffer(
             v.withdrawal_credentials, dtype=np.uint8)
-        self.effective_balance[i] = v.effective_balance
-        self.slashed[i] = v.slashed
-        self.activation_eligibility_epoch[i] = v.activation_eligibility_epoch
-        self.activation_epoch[i] = v.activation_epoch
-        self.exit_epoch[i] = v.exit_epoch
-        self.withdrawable_epoch[i] = v.withdrawable_epoch
+        self._effective_balance[i] = v.effective_balance
+        self._slashed[i] = v.slashed
+        self._activation_eligibility_epoch[i] = v.activation_eligibility_epoch
+        self._activation_epoch[i] = v.activation_epoch
+        self._exit_epoch[i] = v.exit_epoch
+        self._withdrawable_epoch[i] = v.withdrawable_epoch
 
     def _grow(self, need: int) -> None:
-        cap = self.effective_balance.shape[0]
+        cap = self._effective_balance.shape[0]
         if need <= cap:
             return
         new_cap = max(need, cap * 2)
         for name in self._COLUMNS:
-            old = getattr(self, name)
+            old = getattr(self, "_" + name)
             new = np.empty((new_cap,) + old.shape[1:], dtype=old.dtype)
             new[:self._n] = old[:self._n]
             if old.dtype == np.uint64 and name in _EPOCH_FIELDS:
                 new[self._n:] = FAR_FUTURE_EPOCH
             else:
                 new[self._n:] = 0
-            setattr(self, name, new)
+            setattr(self, "_" + name, new)
 
     def append(self, v: Validator) -> None:
         self._grow(self._n + 1)
@@ -165,7 +187,9 @@ class ValidatorRegistry:
         out = ValidatorRegistry.__new__(type(self))
         out._n = self._n
         for name in self._COLUMNS:
-            setattr(out, name, getattr(self, name)[:self._n].copy())
+            setattr(out, "_" + name, getattr(self, "_" + name)[:self._n].copy())
+        out._dirty_cols = set(self._dirty_cols)
+        out._dirty_rows = set(self._dirty_rows)
         return out
 
     def __eq__(self, other):
@@ -192,12 +216,12 @@ class ValidatorRegistry:
 
     def to_packed(self) -> bytes:
         arr = np.empty(self._n, dtype=_VALIDATOR_DTYPE)
-        arr["pubkey"] = self.pubkey[:self._n]
-        arr["withdrawal_credentials"] = self.withdrawal_credentials[:self._n]
-        arr["effective_balance"] = self.effective_balance[:self._n]
-        arr["slashed"] = self.slashed[:self._n].astype(np.uint8)
+        arr["pubkey"] = self._pubkey[:self._n]
+        arr["withdrawal_credentials"] = self._withdrawal_credentials[:self._n]
+        arr["effective_balance"] = self._effective_balance[:self._n]
+        arr["slashed"] = self._slashed[:self._n].astype(np.uint8)
         for f in _EPOCH_FIELDS:
-            arr[f] = getattr(self, f)[:self._n]
+            arr[f] = getattr(self, "_" + f)[:self._n]
         return arr.tobytes()
 
     @classmethod
@@ -208,46 +232,54 @@ class ValidatorRegistry:
         n = arr.shape[0]
         out = cls(n)
         out._n = n
-        out.pubkey[:n] = arr["pubkey"]
-        out.withdrawal_credentials[:n] = arr["withdrawal_credentials"]
-        out.effective_balance[:n] = arr["effective_balance"]
+        out._pubkey[:n] = arr["pubkey"]
+        out._withdrawal_credentials[:n] = arr["withdrawal_credentials"]
+        out._effective_balance[:n] = arr["effective_balance"]
         if arr["slashed"].size and (arr["slashed"] > 1).any():
             raise SszError("invalid boolean byte in validator record")
-        out.slashed[:n] = arr["slashed"].astype(bool)
+        out._slashed[:n] = arr["slashed"].astype(bool)
         for f in _EPOCH_FIELDS:
-            getattr(out, f)[:n] = arr[f]
+            getattr(out, "_" + f)[:n] = arr[f]
         return out
 
     # -- Merkleization (the hot path) ---------------------------------------
 
-    def record_roots_words(self) -> np.ndarray:
-        """Per-validator hash_tree_roots as ``(n, 8)`` u32 words — one
+    def record_roots_words(self, indices=None) -> np.ndarray:
+        """Per-validator hash_tree_roots as ``(k, 8)`` u32 words — one
         batched device program (vs rayon-per-arena in the reference,
-        ``tree_hash_cache.rs:535-556``)."""
+        ``tree_hash_cache.rs:535-556``).  ``indices`` restricts to a subset
+        (the incremental cache recomputes only dirty records)."""
         from ..ops.merkle import HOST_DISPATCH_THRESHOLD, hash64_host_words
+        from ..ops.tree_cache import HASH_COUNT
         n = self._n
-        if n == 0:
+        sel = np.arange(n) if indices is None else np.asarray(indices)
+        k = sel.shape[0]
+        if k == 0:
             return np.zeros((0, 8), dtype=np.uint32)
-        h64 = (hash64_host_words if n <= HOST_DISPATCH_THRESHOLD
-               else lambda a, b: np.asarray(hash64(a, b)))
-        pk = self.pubkey[:n]
-        pk_hi = np.zeros((n, 32), dtype=np.uint8)
+        inner = (hash64_host_words if k <= HOST_DISPATCH_THRESHOLD
+                 else lambda a, b: np.asarray(hash64(a, b)))
+
+        def h64(a, b):
+            HASH_COUNT[0] += int(np.prod(a.shape[:-1], dtype=np.int64))
+            return inner(a, b)
+        pk = self._pubkey[sel]
+        pk_hi = np.zeros((k, 32), dtype=np.uint8)
         pk_hi[:, :16] = pk[:, 32:]
         pubkey_root = h64(bytes_col_to_words(pk[:, :32]),
                           bytes_col_to_words(pk_hi))
         leaves = np.stack([
             np.asarray(pubkey_root),
-            bytes_col_to_words(self.withdrawal_credentials[:n]),
-            u64_to_chunk_words(self.effective_balance[:n]),
-            u64_to_chunk_words(self.slashed[:n].astype(np.uint64)),
-            u64_to_chunk_words(self.activation_eligibility_epoch[:n]),
-            u64_to_chunk_words(self.activation_epoch[:n]),
-            u64_to_chunk_words(self.exit_epoch[:n]),
-            u64_to_chunk_words(self.withdrawable_epoch[:n]),
-        ], axis=1)  # (n, 8, 8)
-        l1 = h64(leaves[:, 0::2], leaves[:, 1::2])   # (n, 4, 8)
-        l2 = h64(l1[:, 0::2], l1[:, 1::2])           # (n, 2, 8)
-        l3 = h64(l2[:, 0], l2[:, 1])                 # (n, 8)
+            bytes_col_to_words(self._withdrawal_credentials[sel]),
+            u64_to_chunk_words(self._effective_balance[sel]),
+            u64_to_chunk_words(self._slashed[sel].astype(np.uint64)),
+            u64_to_chunk_words(self._activation_eligibility_epoch[sel]),
+            u64_to_chunk_words(self._activation_epoch[sel]),
+            u64_to_chunk_words(self._exit_epoch[sel]),
+            u64_to_chunk_words(self._withdrawable_epoch[sel]),
+        ], axis=1)  # (k, 8, 8)
+        l1 = h64(leaves[:, 0::2], leaves[:, 1::2])   # (k, 4, 8)
+        l2 = h64(l1[:, 0::2], l1[:, 1::2])           # (k, 2, 8)
+        l3 = h64(l2[:, 0], l2[:, 1])                 # (k, 8)
         return np.asarray(l3)
 
     def hash_tree_root(self, limit: int) -> bytes:
@@ -256,6 +288,22 @@ class ValidatorRegistry:
         from .columns import device_merkle_root
         return device_merkle_root(self.record_roots_words(), limit,
                                   length_mixin=self._n)
+
+
+def _column_property(name: str) -> property:
+    def get(self):
+        v = getattr(self, "_" + name).view()
+        v.flags.writeable = False
+        return v
+    get.__doc__ = (f"Read-only view of the {name} column storage (full "
+                   "capacity); mutate via wcol()/set()/append() so the "
+                   "incremental hash cache sees the change.")
+    return property(get)
+
+
+for _cname in ValidatorRegistry._COLUMNS:
+    setattr(ValidatorRegistry, _cname, _column_property(_cname))
+del _cname
 
 
 _registry_type_cache: dict[int, type] = {}

@@ -384,8 +384,8 @@ def test_slashed_validator_epoch_penalty():
     h = make_harness()
     st = h.state
     reg = st.validators
-    reg.col("slashed")[2] = True
-    reg.col("withdrawable_epoch")[2] = \
+    reg.wcol("slashed")[2] = True
+    reg.wcol("withdrawable_epoch")[2] = \
         h.preset.EPOCHS_PER_SLASHINGS_VECTOR // 2  # cur epoch is 0
     st.slashings[0] = np.uint64(32_000_000_000)
     before = int(st.balances[2])
@@ -441,7 +441,7 @@ def test_partial_withdrawal_sweep():
     # Excess balance on a validator inside the upcoming sweep window.
     idx = int(h.state.next_withdrawal_validator_index)
     creds = b"\x01" + b"\x00" * 11 + b"\xaa" * 20
-    h.state.validators.col("withdrawal_credentials")[idx] = np.frombuffer(
+    h.state.validators.wcol("withdrawal_credentials")[idx] = np.frombuffer(
         creds, dtype=np.uint8)
     h.state.balances[idx] = MINIMAL.MAX_EFFECTIVE_BALANCE + 5_000_000_000
     sb = h.build_block()
@@ -496,7 +496,7 @@ def test_effective_balance_hysteresis():
 def test_ejection_below_threshold():
     h = make_harness()
     h.state.balances[4] = 1_000_000_000
-    h.state.validators.col("effective_balance")[4] = \
+    h.state.validators.wcol("effective_balance")[4] = \
         h.spec.ejection_balance
     h.extend_chain(h.preset.SLOTS_PER_EPOCH)
     assert int(h.state.validators.col("exit_epoch")[4]) != FAR_FUTURE_EPOCH

@@ -1,0 +1,138 @@
+"""Incremental tree-hash cache: O(changes·log n) recompute + exactness.
+
+Mirrors the reference's ``cached_tree_hash`` tests
+(``/root/reference/consensus/cached_tree_hash/src/test.rs`` — roundtrips,
+mutation patterns, growth) plus the hash-count instrumentation VERDICT asked
+for: mutating k validators must re-hash only O(k·log n) nodes.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.ops.merkle import merkleize_host, mix_in_length_host
+from lighthouse_tpu.ops.tree_cache import HASH_COUNT, IncrementalMerkleCache
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+RNG = np.random.default_rng(7)
+
+
+def _host_root(leaves: np.ndarray, limit: int, length=None) -> bytes:
+    chunks = [leaves[i].astype(">u4").tobytes() for i in range(leaves.shape[0])]
+    root = merkleize_host(chunks, limit=limit)
+    if length is not None:
+        root = mix_in_length_host(root, length)
+    return root
+
+
+def _rand_leaves(k: int) -> np.ndarray:
+    return RNG.integers(0, 2**32, size=(k, 8), dtype=np.uint64).astype(np.uint32)
+
+
+def test_cache_matches_host_on_mutation_growth_shrink():
+    cache = IncrementalMerkleCache(1 << 12, mixin_length=True)
+    leaves = _rand_leaves(100)
+    assert cache.root_words(leaves.copy(), 100) == _host_root(leaves, 1 << 12, 100)
+    # mutate a few
+    leaves[3] ^= 1
+    leaves[97] ^= 0xFFFF
+    assert cache.root_words(leaves.copy(), 100) == _host_root(leaves, 1 << 12, 100)
+    # grow within the same power-of-two width
+    leaves = np.concatenate([leaves, _rand_leaves(20)])
+    assert cache.root_words(leaves.copy(), 120) == _host_root(leaves, 1 << 12, 120)
+    # grow past the width (rebuild path)
+    leaves = np.concatenate([leaves, _rand_leaves(200)])
+    assert cache.root_words(leaves.copy(), 320) == _host_root(leaves, 1 << 12, 320)
+    # shrink (width change → rebuild)
+    leaves = leaves[:40]
+    assert cache.root_words(leaves.copy(), 40) == _host_root(leaves, 1 << 12, 40)
+
+
+def test_cache_hash_count_is_o_k_log_n():
+    n = 1 << 14
+    cache = IncrementalMerkleCache(1 << 20, mixin_length=False)
+    leaves = _rand_leaves(n)
+    cache.root_words(leaves.copy())
+    depth_real = 14
+    for k in (1, 7, 64):
+        idx = RNG.choice(n, size=k, replace=False)
+        leaves[idx, 0] ^= 0x1234
+        before = HASH_COUNT[0]
+        r = cache.root_words(leaves.copy())
+        spent = HASH_COUNT[0] - before
+        # k dirty paths of ≤ depth hashes, + (limit−subtree) zero folds.
+        assert spent <= k * depth_real + (20 - depth_real) + 2, (k, spent)
+        assert r == _host_root(leaves, 1 << 20)
+
+
+def test_unchanged_root_costs_almost_nothing():
+    cache = IncrementalMerkleCache(1 << 10, mixin_length=False)
+    leaves = _rand_leaves(256)
+    cache.root_words(leaves.copy())
+    before = HASH_COUNT[0]
+    cache.root_words(leaves.copy())
+    assert HASH_COUNT[0] - before <= 3  # zero folds only
+
+
+def test_state_cached_root_matches_uncached():
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=64, preset=MINIMAL)
+        st = h.state
+        cached = st.tree_hash_root()
+        uncached = type(st).hash_tree_root(st)  # classmethod path, no cache
+        assert cached == uncached
+        # Drive real blocks through the cached path and re-check every slot.
+        h.extend_chain(3)
+        cached = h.state.tree_hash_root()
+        assert cached == type(h.state).hash_tree_root(h.state)
+    finally:
+        B.set_backend("python")
+
+
+def test_state_cache_survives_copy_and_diverges():
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=64, preset=MINIMAL)
+        h.state.tree_hash_root()
+        fork_a = h.state.copy()
+        fork_b = h.state.copy()
+        fork_a.wcol_probe = None  # ensure attribute dicts are independent
+        fork_a.validators.wcol("effective_balance")[0] = 31 * 10**9
+        fork_b.balances[1] += 5
+        ra = fork_a.tree_hash_root()
+        rb = fork_b.tree_hash_root()
+        assert ra != rb
+        assert ra == type(fork_a).hash_tree_root(fork_a)
+        assert rb == type(fork_b).hash_tree_root(fork_b)
+        # The original is untouched by either mutation.
+        assert h.state.tree_hash_root() == type(h.state).hash_tree_root(h.state)
+    finally:
+        B.set_backend("python")
+
+
+def test_per_slot_root_is_incremental_after_block():
+    """After one cached root, applying a small mutation set re-hashes far
+    less than a full state rebuild would."""
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=64, preset=MINIMAL)
+        h.state.tree_hash_root()
+        h.state.validators.wcol("effective_balance")[7] -= 10**9
+        h.state.balances[7] -= 10**9
+        before = HASH_COUNT[0]
+        h.state.tree_hash_root()
+        spent = HASH_COUNT[0] - before
+        # Two dirty paths at depth-40 limits (~40 hashes each incl. the
+        # zero-cap folds) + the container fold; a full uncached rebuild at
+        # 64 validators costs thousands (64·8 record hashes + every field).
+        assert spent < 400, spent
+    finally:
+        B.set_backend("python")
+
+
+def test_registry_unmarked_write_raises():
+    h = StateHarness(n_validators=8, preset=MINIMAL)
+    with pytest.raises(ValueError):
+        h.state.validators.col("slashed")[0] = True

@@ -117,10 +117,10 @@ def test_registry_append_and_mutate():
     for _ in range(20):
         reg.append(make_validator(rng))
     assert len(reg) == 20
-    reg.col("effective_balance")[:] = 31 * 10**9
+    reg.wcol("effective_balance")[:] = 31 * 10**9
     assert reg[7].effective_balance == 31 * 10**9
     cp = reg.copy()
-    cp.col("effective_balance")[0] = 1
+    cp.wcol("effective_balance")[0] = 1
     assert reg[0].effective_balance == 31 * 10**9
 
 
@@ -215,7 +215,7 @@ def test_state_copy_isolates_registry():
     st.validators.append(make_validator(rng))
     st.balances = np.array([32 * 10**9], dtype=np.uint64)
     cp = st.copy()
-    cp.validators.col("effective_balance")[0] = 7
+    cp.validators.wcol("effective_balance")[0] = 7
     cp.balances[0] = 7
     assert st.validators[0].effective_balance != 7
     assert st.balances[0] == 32 * 10**9
