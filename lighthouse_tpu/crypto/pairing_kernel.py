@@ -1,0 +1,815 @@
+"""Pallas TPU kernels for batched BLS12-381 pairing — the production path.
+
+The XLA limb modules (:mod:`.limb_field`/:mod:`.limb_pairing`) are
+semantically exact but dispatch-bound: a Miller loop lowers to ~400k tiny
+kernel launches, and at ~0.4 ms/launch on the axon tunnel that is minutes
+per batch.  Here the whole loop body lives inside single Pallas programs —
+the same move as the Merkle sub-tree kernel (:mod:`..ops.merkle_kernel`) —
+so one launch runs the full 63-iteration Miller loop for a lane-batch of
+pairs with every intermediate in VMEM/registers.
+
+Data layout: **limb planes**.  An Fq element batch is a ``(26, M)`` uint32
+array — 16-bit limbs down the sublanes, M independent elements across the
+vector lanes.  Tower elements are python tuples of planes (Fq2 = 2, Fq6 =
+3×Fq2, Fq12 = 2×Fq6), and every tower multiply concatenates its base
+products along the lane axis so the kernel issues ONE wide ``mont_mul``
+per level — Karatsuba all the way down (3/6/18 ⇒ 54 base products per
+Fq12 multiply instead of schoolbook 144).
+
+Mosaic rejects captured array constants, so every field/Frobenius constant
+is packed into one ``(rows, 1)`` uint32 input (:data:`CONSTS_PLANES`) and
+the static exponent bit strings ride along as SMEM inputs; kernels call
+:func:`_bind_consts` first, and the in-kernel helpers read the bound
+slices.  Semantics are bit-identical to the XLA path (Montgomery residues
+< 2N, full-width reduction, HHT cubed final exponentiation), so the host
+oracle (:mod:`.pairing`) validates both.
+
+Kernels:
+
+- :func:`miller_kernel_call` — batched Miller loops (63-iter fori_loop
+  in-kernel, conditional add-step lane-selected per the static bit string).
+- :func:`product_kernel_call` — masked lane product folded (lane-roll
+  butterfly) down to 128 residue-class products; the host multiplies those
+  and runs ONE shared :func:`..pairing.final_exponentiation_cubed`.
+- :func:`prepare_kernel_call` — per-set G1 pubkey aggregation (K-major
+  lane blocks, sequential-K fori accumulate), 64-bit RLC double-and-add
+  ladders for the aggregates and for −c_i·G, and batched Fermat-ladder
+  affine conversion; the signature side of the RLC rides the pairing
+  bilinearity (∏ e(c_i·pk_i, H_i)·∏ e(−c_i·G, σ_i) == 1), so no G2
+  ladder exists at all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import fields as F
+from . import limb_field as LF
+from . import limb_pairing as XP
+
+LIMBS = 26
+M16 = np.uint32(0xFFFF)
+U32 = jnp.uint32
+
+X_BITS_MILLER = XP.X_BITS_MILLER     # 63 bits, MSB-first (implicit top 1)
+X_BITS_FULL = XP.X_BITS_FULL         # 64 bits
+P_MINUS_2_BITS = XP.P_MINUS_2_BITS   # 379 bits
+
+
+# -- packed constants --------------------------------------------------------
+
+def _build_consts() -> tuple[np.ndarray, dict]:
+    """Stack every plane constant into one (rows, 1) u32 array + slice map.
+
+    Each block is padded to 32 rows so every in-kernel slice starts on a
+    sublane-tile boundary — Mosaic gives offset layouts to unaligned
+    slices, and a later lane-concat of mixed-offset pieces fails to lower.
+    """
+    blocks: list[np.ndarray] = []
+    index: dict[str, tuple[int, int]] = {}
+
+    def put(name: str, limbs: np.ndarray):
+        start = sum(b.shape[0] for b in blocks)
+        arr = np.asarray(limbs, np.uint32).reshape(-1, 1)
+        pad = (-arr.shape[0]) % 32
+        if pad:
+            arr = np.concatenate([arr, np.zeros((pad, 1), np.uint32)])
+        blocks.append(arr)
+        index[name] = (start, start + len(np.asarray(limbs).reshape(-1)))
+
+    put("N", LF.N_LIMBS)
+    put("NPRIME", LF._NPRIME_LIMBS)
+    put("N2", LF.N2_LIMBS)
+    put("ONE", LF.ONE_MONT)
+    for k in (2, 4, 8, 16):
+        put(f"K{k}", LF.int_to_limbs(k * F.P))
+    for k in range(8):
+        put(f"ZP{k}", LF.int_to_limbs(k * F.P))
+    for n in (1, 2, 3):
+        gam = np.asarray(XP._GAMMA[n])  # (2, 3, 2, 26)
+        for i in range(2):
+            for j in range(3):
+                for u in range(2):
+                    put(f"FROB{n}_{i}{j}{u}", gam[i, j, u])
+    from . import curve as C
+    ng = C.g1_neg(C.G1_GEN)
+    put("NEGG_X", LF.to_mont(ng[0]))
+    put("NEGG_Y", LF.to_mont(ng[1]))
+    return np.concatenate(blocks, axis=0), index
+
+
+CONSTS_PLANES, _CONST_INDEX = _build_consts()
+
+# Bound during kernel tracing: name → plane value; plus bit-string refs.
+_KC: dict = {}
+
+
+def _bind_consts(cref, xbits_ref=None, pbits_ref=None) -> None:
+    c = cref[:]
+    for name, (a, b) in _CONST_INDEX.items():
+        _KC[name] = c[a:b]
+    for n in (1, 2, 3):
+        _KC[f"FROBT{n}"] = tuple(
+            tuple((_KC[f"FROB{n}_{i}{j}0"], _KC[f"FROB{n}_{i}{j}1"])
+                  for j in range(3)) for i in range(2))
+    _KC["xbits"] = xbits_ref
+    _KC["pbits"] = pbits_ref
+
+
+def _const_specs():
+    return [pl.BlockSpec(memory_space=pltpu.VMEM),   # consts
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # x bits
+            pl.BlockSpec(memory_space=pltpu.SMEM)]   # p−2 bits
+
+
+def _const_args():
+    return (jnp.asarray(CONSTS_PLANES),
+            jnp.asarray(X_BITS_FULL.reshape(-1, 1).astype(np.int32)),
+            jnp.asarray(P_MINUS_2_BITS.reshape(-1, 1).astype(np.int32)))
+
+
+# ---------------------------------------------------------------------------
+# In-kernel base-field ops on (26, M) planes
+# ---------------------------------------------------------------------------
+
+
+def k_carry(t, ncols: int, keep_carry: bool = False):
+    """Ripple-normalize ``ncols`` uint32 columns (< 2^23) to 16-bit limbs."""
+    rows = []
+    c = jnp.zeros_like(t[0:1])
+    for k in range(ncols):
+        v = t[k:k + 1] + c
+        rows.append(v & M16)
+        c = v >> np.uint32(16)
+    if keep_carry:
+        rows.append(c)
+    return jnp.concatenate(rows, axis=0)
+
+
+def k_carry_i32(d, ncols: int):
+    """Signed ripple for int32 columns (value in [0, 2^(16·ncols)))."""
+    rows = []
+    c = jnp.zeros_like(d[0:1])
+    for k in range(ncols):
+        v = d[k:k + 1] + c
+        rows.append(v & np.int32(0xFFFF))
+        c = v >> 16
+    return jnp.concatenate(rows, axis=0).astype(U32)
+
+
+def _cond_sub_raw(x, k_plane):
+    d = x.astype(jnp.int32) - k_plane.astype(jnp.int32)
+    rows = []
+    c = jnp.zeros_like(d[0:1])
+    for k in range(LIMBS):
+        v = d[k:k + 1] + c
+        rows.append(v & np.int32(0xFFFF))
+        c = v >> 16
+    norm = jnp.concatenate(rows, axis=0).astype(U32)
+    return jnp.where(c == 0, norm, x)
+
+
+def k_add(a, b):
+    """a + b < 2N (cond-subtracted), matching :func:`..limb_field.add`."""
+    return _cond_sub_raw(k_carry(a + b, LIMBS), _KC["N2"])
+
+
+def k_sub(a, b):
+    d = a.astype(jnp.int32) + _KC["N2"].astype(jnp.int32) - b.astype(jnp.int32)
+    return _cond_sub_raw(k_carry_i32(d, LIMBS), _KC["N2"])
+
+
+def k_neg(a):
+    d = _KC["N2"].astype(jnp.int32) - a.astype(jnp.int32)
+    return k_carry_i32(d, LIMBS)
+
+
+def k_muls(a, s: int):
+    """a · s for small 0 ≤ s ≤ 16, reduced below 2N (value < 32N < 2^416)."""
+    if not 0 <= s <= 16:
+        raise ValueError("small-scalar multiply supports 0..16")
+    x = k_carry(a * np.uint32(s), LIMBS)
+    for k in (16, 8, 4, 2):
+        x = _cond_sub_raw(x, _KC[f"K{k}"])
+    return x
+
+
+def k_band(a, b, ncols: int):
+    """Schoolbook column sums of a·b over planes, pad-and-add form.
+    Columns < 52·2^16 < 2^23."""
+    t = jnp.zeros((ncols, a.shape[1]), U32)
+    for i in range(LIMBS):
+        p = a[i:i + 1] * b
+        lo = p & M16
+        hi = p >> np.uint32(16)
+        wl = min(LIMBS, ncols - i)
+        if wl > 0:
+            t = t + jnp.pad(lo[:wl], ((i, ncols - i - wl), (0, 0)))
+        wh = min(LIMBS, ncols - i - 1)
+        if wh > 0:
+            t = t + jnp.pad(hi[:wh], ((i + 1, ncols - i - 1 - wh), (0, 0)))
+    return t
+
+
+def k_mont_mul(a, b):
+    """Batched Montgomery product on planes — same algorithm and bounds as
+    :func:`..limb_field.mont_mul` (full-width reduction).
+
+    The final carry pass collects only the high 26 rows into a FRESH
+    concat: slicing rows [26:52] out of a 53-row array would give the
+    value a sublane-offset layout, which poisons every later lane-concat
+    it reaches (Mosaic can't mix offset layouts in one concatenate)."""
+    t = k_band(a, b, 2 * LIMBS)
+    t_low = k_carry(t[:LIMBS], LIMBS)
+    m = k_carry(k_band(t_low, _KC["NPRIME"], LIMBS), LIMBS)
+    u = k_band(m, _KC["N"], 2 * LIMBS)
+    s = t + u
+    rows = []
+    c = jnp.zeros_like(s[0:1])
+    for k in range(2 * LIMBS):
+        v = s[k:k + 1] + c
+        if k >= LIMBS:
+            rows.append(v & M16)
+        c = v >> np.uint32(16)
+    # (T + mN)/R < 2N < 2^382 ⇒ the final carry-out is always zero.
+    return jnp.concatenate(rows, axis=0)
+
+
+def k_is_zero(a):
+    """(1, M) bool: a ≡ 0 mod N for lazy values < 8N."""
+    acc = None
+    for k in range(8):
+        eq = jnp.all(a == _KC[f"ZP{k}"], axis=0, keepdims=True)
+        acc = eq if acc is None else (acc | eq)
+    return acc
+
+
+def k_fq_inv(a):
+    """Fermat ladder a^(p−2); inv(0) = 0.  (26, M) planes."""
+    one = jnp.broadcast_to(_KC["ONE"], a.shape)
+    pbits = _KC["pbits"]
+
+    def body(i, acc):
+        acc = k_mont_mul(acc, acc)
+        take = pbits[i, 0] == 1
+        return jnp.where(take, k_mont_mul(acc, a), acc)
+
+    return jax.lax.fori_loop(0, P_MINUS_2_BITS.shape[0], body, one)
+
+
+# ---------------------------------------------------------------------------
+# Tower on plane tuples: Fq2 = (c0, c1); Fq6 = 3×Fq2; Fq12 = 2×Fq6
+# ---------------------------------------------------------------------------
+
+
+def _mont_many(pairs):
+    """One wide mont_mul over a list of (a, b) plane pairs → list of planes."""
+    a = jnp.concatenate([p[0] for p in pairs], axis=1)
+    b = jnp.concatenate([p[1] for p in pairs], axis=1)
+    out = k_mont_mul(a, b)
+    m = pairs[0][0].shape[1]
+    return [out[:, i * m:(i + 1) * m] for i in range(len(pairs))]
+
+
+def fq2_add(a, b):
+    return (k_add(a[0], b[0]), k_add(a[1], b[1]))
+
+
+def fq2_sub(a, b):
+    return (k_sub(a[0], b[0]), k_sub(a[1], b[1]))
+
+
+def fq2_neg(a):
+    return (k_neg(a[0]), k_neg(a[1]))
+
+
+def fq2_conj(a):
+    return (a[0], k_neg(a[1]))
+
+
+def fq2_muls(a, s: int):
+    return (k_muls(a[0], s), k_muls(a[1], s))
+
+
+def fq2_mul_by_xi(a):
+    """ξ = 1 + u:  (a0 − a1) + (a0 + a1)u."""
+    return (k_sub(a[0], a[1]), k_add(a[0], a[1]))
+
+
+def _fq2_mul_parts(a, b):
+    """Karatsuba part list: [a0b0, a1b1, (a0+a1)(b0+b1)]."""
+    return [(a[0], b[0]), (a[1], b[1]),
+            (k_add(a[0], a[1]), k_add(b[0], b[1]))]
+
+
+def _fq2_from_parts(p):
+    m0, m1, m2 = p
+    return (k_sub(m0, m1), k_sub(m2, k_add(m0, m1)))
+
+
+def fq2_mul(a, b):
+    return _fq2_from_parts(_mont_many(_fq2_mul_parts(a, b)))
+
+
+def fq2_mul_many(pairs):
+    """Batch several independent Fq2 products into one mont_mul."""
+    parts = []
+    for a, b in pairs:
+        parts.extend(_fq2_mul_parts(a, b))
+    flat = _mont_many(parts)
+    return [_fq2_from_parts(flat[3 * i:3 * i + 3]) for i in range(len(pairs))]
+
+
+def _fq6_mul_pairs(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    return [(a0, b0), (a1, b1), (a2, b2),
+            (fq2_add(a0, a1), fq2_add(b0, b1)),
+            (fq2_add(a1, a2), fq2_add(b1, b2)),
+            (fq2_add(a0, a2), fq2_add(b0, b2))]
+
+
+def _fq6_from_parts(v):
+    v0, v1, v2, v01, v12, v02 = v
+    c0 = fq2_add(v0, fq2_mul_by_xi(fq2_sub(v12, fq2_add(v1, v2))))
+    c1 = fq2_add(fq2_sub(v01, fq2_add(v0, v1)), fq2_mul_by_xi(v2))
+    c2 = fq2_add(fq2_sub(v02, fq2_add(v0, v2)), v1)
+    return (c0, c1, c2)
+
+
+def fq6_mul(a, b):
+    return _fq6_from_parts(fq2_mul_many(_fq6_mul_pairs(a, b)))
+
+
+def fq6_add(a, b):
+    return tuple(fq2_add(x, y) for x, y in zip(a, b))
+
+
+def fq6_sub(a, b):
+    return tuple(fq2_sub(x, y) for x, y in zip(a, b))
+
+
+def fq6_neg(a):
+    return tuple(fq2_neg(x) for x in a)
+
+
+def fq6_mul_by_v(a):
+    return (fq2_mul_by_xi(a[2]), a[0], a[1])
+
+
+def fq12_mul(a, b):
+    """Karatsuba-2 over Fq6: 3 Fq6 products in one wide mont_mul."""
+    a0, a1 = a
+    b0, b1 = b
+    pairs = (_fq6_mul_pairs(a0, b0) + _fq6_mul_pairs(a1, b1)
+             + _fq6_mul_pairs(fq6_add(a0, a1), fq6_add(b0, b1)))
+    flat = fq2_mul_many(pairs)
+    v00 = _fq6_from_parts(flat[0:6])
+    v11 = _fq6_from_parts(flat[6:12])
+    vxx = _fq6_from_parts(flat[12:18])
+    c0 = fq6_add(v00, fq6_mul_by_v(v11))
+    c1 = fq6_sub(vxx, fq6_add(v00, v11))
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    return fq12_mul(a, a)
+
+
+def fq12_conj(a):
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_select(take, a, b):
+    return tuple(tuple((jnp.where(take, x, y), jnp.where(take, u, v))
+                       for (x, u), (y, v) in zip(ca, cb))
+                 for ca, cb in zip(a, b))
+
+
+def fq12_one_like(m: int):
+    one = jnp.broadcast_to(_KC["ONE"], (LIMBS, m))
+    zero = jnp.zeros((LIMBS, m), U32)
+    return (((one, zero), (zero, zero), (zero, zero)),
+            ((zero, zero), (zero, zero), (zero, zero)))
+
+
+def fq12_frobenius(a, n: int):
+    tab = _KC[f"FROBT{n}"]
+    pairs = []
+    for i in range(2):
+        for j in range(3):
+            c = a[i][j]
+            if n % 2:
+                c = fq2_conj(c)
+            g = tab[i][j]
+            gb = (jnp.broadcast_to(g[0], c[0].shape),
+                  jnp.broadcast_to(g[1], c[1].shape))
+            pairs.append((c, gb))
+    muls = fq2_mul_many(pairs)
+    return ((muls[0], muls[1], muls[2]), (muls[3], muls[4], muls[5]))
+
+
+def fq2_inv(a):
+    n = k_add(k_mont_mul(a[0], a[0]), k_mont_mul(a[1], a[1]))
+    ni = k_fq_inv(n)
+    return (k_mont_mul(a[0], ni), k_mont_mul(k_neg(a[1]), ni))
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    p = fq2_mul_many([(a0, a0), (a1, a2), (a2, a2), (a1, a1),
+                      (a0, a1), (a0, a2)])
+    a00, a12, a22, a11, a01, a02 = p
+    c0 = fq2_sub(a00, fq2_mul_by_xi(a12))
+    c1 = fq2_sub(fq2_mul_by_xi(a22), a01)
+    c2 = fq2_sub(a11, a02)
+    q = fq2_mul_many([(a0, c0), (a2, c1), (a1, c2)])
+    nrm = fq2_add(q[0], fq2_mul_by_xi(fq2_add(q[1], q[2])))
+    ni = fq2_inv(nrm)
+    inv = fq2_mul_many([(c0, ni), (c1, ni), (c2, ni)])
+    return (inv[0], inv[1], inv[2])
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    s0 = fq6_mul(a0, a0)
+    s1 = fq6_mul(a1, a1)
+    nrm = fq6_sub(s0, fq6_mul_by_v(s1))
+    ni = fq6_inv(nrm)
+    return (fq6_mul(a0, ni), fq6_mul(fq6_neg(a1), ni))
+
+
+def fq12_is_one(a):
+    one = fq12_one_like(a[0][0][0].shape[1])
+    acc = None
+    for i in range(2):
+        for j in range(3):
+            for u in range(2):
+                z = k_is_zero(k_sub(a[i][j][u], one[i][j][u]))
+                acc = z if acc is None else (acc & z)
+    return acc
+
+
+# -- plane packing: 32-row blocks ↔ tuples ----------------------------------
+#
+# Ref I/O uses one 32-row block per Fq plane (26 limb rows + 6 zero rows):
+# slicing a ref at a non-multiple-of-8 row gives the value a sublane-offset
+# layout, and Mosaic cannot lane-concat mixed-offset pieces (same reason the
+# constant blocks are 32-row padded).
+
+BLOCK_ROWS = 32
+
+
+def pack_planes(planes):
+    """List of (26, M) planes → (32·k, M) block layout."""
+    m = planes[0].shape[1]
+    z = jnp.zeros((BLOCK_ROWS - LIMBS, m), U32)
+    out = []
+    for p in planes:
+        out.append(p)
+        out.append(z)
+    return jnp.concatenate(out, axis=0)
+
+
+def unpack_planes(x, k: int):
+    return [x[i * BLOCK_ROWS:i * BLOCK_ROWS + LIMBS] for i in range(k)]
+
+
+def pack_fq12(a):
+    return pack_planes([a[i][j][u] for i in range(2) for j in range(3)
+                        for u in range(2)])
+
+
+def unpack_fq12(x):
+    c = unpack_planes(x, 12)
+    return (((c[0], c[1]), (c[2], c[3]), (c[4], c[5])),
+            ((c[6], c[7]), (c[8], c[9]), (c[10], c[11])))
+
+
+def unpack_fq2s(x, k: int):
+    c = unpack_planes(x, 2 * k)
+    return [(c[2 * i], c[2 * i + 1]) for i in range(k)]
+
+
+# ---------------------------------------------------------------------------
+# Generic projective point ops (RCB complete addition), G1/G2
+# ---------------------------------------------------------------------------
+
+
+class _G1ops:
+    coord_planes = LIMBS
+    mul_many = staticmethod(_mont_many)
+    add = staticmethod(k_add)
+    sub = staticmethod(k_sub)
+
+    @staticmethod
+    def b3(t):
+        return k_muls(t, 12)
+
+    muls = staticmethod(k_muls)
+
+    @staticmethod
+    def zero_is(z):
+        return k_is_zero(z)
+
+    @staticmethod
+    def one_like(m):
+        return jnp.broadcast_to(_KC["ONE"], (LIMBS, m))
+
+    @staticmethod
+    def zero_like(m):
+        return jnp.zeros((LIMBS, m), U32)
+
+
+class _G2ops:
+    coord_planes = 2 * LIMBS
+    mul_many = staticmethod(fq2_mul_many)
+    add = staticmethod(fq2_add)
+    sub = staticmethod(fq2_sub)
+
+    @staticmethod
+    def b3(t):
+        return fq2_muls(fq2_mul_by_xi(t), 12)
+
+    muls = staticmethod(fq2_muls)
+
+    @staticmethod
+    def zero_is(z):
+        return k_is_zero(z[0]) & k_is_zero(z[1])
+
+    @staticmethod
+    def one_like(m):
+        return (jnp.broadcast_to(_KC["ONE"], (LIMBS, m)),
+                jnp.zeros((LIMBS, m), U32))
+
+    @staticmethod
+    def zero_like(m):
+        return (jnp.zeros((LIMBS, m), U32), jnp.zeros((LIMBS, m), U32))
+
+
+def point_add(ops, p, q):
+    """Complete addition (same formulas/order as limb_curve.point_add)."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    r1 = ops.mul_many([
+        (X1, X2), (Y1, Y2), (Z1, Z2),
+        (ops.add(X1, Y1), ops.add(X2, Y2)),
+        (ops.add(Y1, Z1), ops.add(Y2, Z2)),
+        (ops.add(X1, Z1), ops.add(X2, Z2))])
+    t0, t1, t2, pxy, pyz, pxz = r1
+    s3 = ops.sub(pxy, ops.add(t0, t1))
+    s4 = ops.sub(pyz, ops.add(t1, t2))
+    s5 = ops.sub(pxz, ops.add(t0, t2))
+    b3t2 = ops.b3(t2)
+    um = ops.sub(t1, b3t2)
+    up = ops.add(t1, b3t2)
+    r2 = ops.mul_many([
+        (s3, um), (s4, s5), (up, um), (t0, s5), (s4, up), (t0, s3)])
+    a_s3um, a_s4s5, a_upum, a_t0s5, a_s4up, a_t0s3 = r2
+    X3 = ops.sub(a_s3um, ops.b3(a_s4s5))
+    Y3 = ops.add(a_upum, ops.muls(ops.b3(a_t0s5), 3))
+    Z3 = ops.add(a_s4up, ops.muls(a_t0s3, 3))
+    return (X3, Y3, Z3)
+
+
+def point_select(ops, take, p, q):
+    def sel(a, b):
+        if isinstance(a, tuple):
+            return tuple(jnp.where(take, x, y) for x, y in zip(a, b))
+        return jnp.where(take, a, b)
+    return tuple(sel(a, b) for a, b in zip(p, q))
+
+
+def point_identity(ops, m: int):
+    return (ops.zero_like(m), ops.one_like(m), ops.zero_like(m))
+
+
+def scalar_mul(ops, p, lo, hi, nbits: int = 64):
+    """Per-lane double-and-add; lo/hi are (1, M) uint32 scalar words."""
+    m = (p[0][0] if isinstance(p[0], tuple) else p[0]).shape[1]
+    acc = point_identity(ops, m)
+
+    def body(i, carry):
+        acc, base = carry
+        word = jnp.where(i < 32, lo, hi)
+        bit = (word >> (i.astype(U32) % np.uint32(32))) & np.uint32(1)
+        added = point_add(ops, acc, base)
+        acc = point_select(ops, bit == 1, added, acc)
+        base = point_add(ops, base, base)
+        return (acc, base)
+
+    acc, _ = jax.lax.fori_loop(0, nbits, body, (acc, p))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Miller loop kernel
+# ---------------------------------------------------------------------------
+
+
+LANE_BLOCK = 128  # Mosaic lane-concat pieces must be 128-aligned
+
+
+def _line_fq12(A, B, C, m):
+    zero = (jnp.zeros((LIMBS, m), U32), jnp.zeros((LIMBS, m), U32))
+    return ((A, B, zero), (zero, C, zero))
+
+
+def _fq2_mul_fq(a, s):
+    o = _mont_many([(a[0], s), (a[1], s)])
+    return (o[0], o[1])
+
+
+def _miller_body(f, T, Qx, Qy, Q, xP, yP, bit):
+    m = xP.shape[1]
+    # Doubling line: A = 3X³−2Y²Z, B = −3X²Z·xP, C = 2YZ²·yP.
+    X, Y, Z = T
+    XX, YY, ZZ = fq2_mul_many([(X, X), (Y, Y), (Z, Z)])
+    X3, Y2Z, X2Z, YZ2 = fq2_mul_many([(X, XX), (YY, Z), (XX, Z), (Y, ZZ)])
+    A = fq2_sub(fq2_muls(X3, 3), fq2_muls(Y2Z, 2))
+    B = fq2_neg(_fq2_mul_fq(fq2_muls(X2Z, 3), xP))
+    C = _fq2_mul_fq(fq2_muls(YZ2, 2), yP)
+    l_dbl = _line_fq12(A, B, C, m)
+    T2 = point_add(_G2ops, T, T)
+    f = fq12_mul(fq12_sqr(f), l_dbl)
+    # Conditional add step: chord through (T2, Q).
+    X, Y, Z = T2
+    r = fq2_mul_many([(Qy, Z), (Qx, Z)])
+    Nn = fq2_sub(r[0], Y)
+    Dd = fq2_sub(r[1], X)
+    r2 = fq2_mul_many([(Nn, Qx), (Qy, Dd)])
+    A = fq2_sub(r2[0], r2[1])
+    B = fq2_neg(_fq2_mul_fq(Nn, xP))
+    C = _fq2_mul_fq(Dd, yP)
+    l_add = _line_fq12(A, B, C, m)
+    T3 = point_add(_G2ops, T2, Q)
+    take = bit == 1
+    f = fq12_select(take, fq12_mul(f, l_add), f)
+    T = point_select(_G2ops, take, T3, T2)
+    return f, T
+
+
+def _miller_kernel(cref, xbits_ref, pbits_ref, g1_ref, g2_ref, out_ref):
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    xP, yP = unpack_planes(g1_ref[:], 2)
+    Qx, Qy = unpack_fq2s(g2_ref[:], 2)
+    m = xP.shape[1]
+    Q = (Qx, Qy, _G2ops.one_like(m))
+    f0 = fq12_one_like(m)
+    xbits = _KC["xbits"]
+
+    def body(i, carry):
+        f, T = carry
+        bit = xbits[i + 1, 0]  # skip the implicit leading 1
+        return _miller_body(f, T, Qx, Qy, Q, xP, yP, bit)
+
+    f, _ = jax.lax.fori_loop(0, X_BITS_MILLER.shape[0], body, (f0, Q))
+    out_ref[:] = pack_fq12(fq12_conj(f))  # x < 0
+
+
+@jax.jit
+def miller_kernel_call(g1_planes, g2_planes):
+    """g1 (64, M) affine blocks, g2 (128, M) → f (384, M) Fq12 blocks.
+
+    M must be a multiple of 128; the grid runs one 128-lane block per cell
+    (bounds both VMEM and per-launch latency)."""
+    m = g1_planes.shape[1]
+    if m % LANE_BLOCK:
+        raise ValueError("pad miller lanes to a multiple of 128")
+    g = m // LANE_BLOCK
+    return pl.pallas_call(
+        _miller_kernel,
+        grid=(g,),
+        in_specs=_const_specs() + [
+            pl.BlockSpec((2 * BLOCK_ROWS, LANE_BLOCK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4 * BLOCK_ROWS, LANE_BLOCK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((12 * BLOCK_ROWS, LANE_BLOCK), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, m), jnp.uint32),
+    )(*_const_args(), g1_planes, g2_planes)
+
+
+def _const_block_specs():
+    """Const specs for gridded kernels: every cell sees the full blocks."""
+    cs = CONSTS_PLANES.shape[0]
+    return [pl.BlockSpec((cs, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM)]
+
+
+# ---------------------------------------------------------------------------
+# Lane-product kernel (butterfly to 128 class products)
+# ---------------------------------------------------------------------------
+
+
+def _product_kernel(cref, xbits_ref, pbits_ref, f_ref, mask_ref, out_ref,
+                    *, lanes: int):
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    f = unpack_fq12(f_ref[:])
+    mask = mask_ref[:]
+    f = fq12_select(mask != 0, f, fq12_one_like(lanes))
+    w = lanes // 2
+    while w >= LANE_BLOCK:
+        # roll by w lanes via aligned concat; multiply-accumulate.
+        def roll(x):
+            return jnp.concatenate([x[:, w:], x[:, :w]], axis=1)
+
+        g = tuple(tuple((roll(c0), roll(c1)) for (c0, c1) in c6) for c6 in f)
+        f = fq12_mul(f, g)
+        w //= 2
+    out_ref[:] = pack_fq12(f)
+
+
+FQ12_ROWS = 12 * BLOCK_ROWS
+
+
+@jax.jit
+def product_kernel_call(f_planes, mask):
+    """Masked lane product, reduced to 128 residue-class products.
+
+    f (384, M) blocks, mask (1, M) int32, M a power of two ≥ 128.  Returns
+    (384, M) blocks where lane j holds the product of lanes ≡ j (mod 128);
+    the host multiplies the first 128 lanes' values for the total.
+    """
+    m = f_planes.shape[1]
+    if m < LANE_BLOCK or m & (m - 1):
+        raise ValueError("lane count must be a power of two ≥ 128")
+    return pl.pallas_call(
+        partial(_product_kernel, lanes=m),
+        in_specs=_const_specs() + [pl.BlockSpec(memory_space=pltpu.VMEM),
+                                   pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, m), jnp.uint32),
+    )(*_const_args(), f_planes, mask)
+
+
+# ---------------------------------------------------------------------------
+# Prepare kernel: G1 aggregation + RLC ladders + affine conversion
+# ---------------------------------------------------------------------------
+
+PREP_S = 128  # sets per prepare launch (lane-block aligned)
+
+
+def _prepare_kernel(cref, xbits_ref, pbits_ref, pk_ref, kmask_ref, lo_ref,
+                    hi_ref, g1_out_ref, flags_ref, *, K: int):
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    S = PREP_S
+    acc = point_identity(_G1ops, S)
+
+    def body(k, acc):
+        off = k * S
+        cols = unpack_planes(pk_ref[:, pl.ds(off, S)], 3)
+        live = kmask_ref[:, pl.ds(off, S)] != 0
+        blk = point_select(_G1ops, live, tuple(cols),
+                           point_identity(_G1ops, S))
+        return point_add(_G1ops, acc, blk)
+
+    acc = jax.lax.fori_loop(0, K, body, acc)
+    # Live sets with identity aggregates are invalid (blst/PythonBackend
+    # rule); report per-lane so the host can also mask those lanes.
+    flags_ref[:] = (k_is_zero(acc[2])).astype(jnp.int32)
+    # Lanes [0:S] = c_i · aggpk_i; lanes [S:2S] = −c_i · G.
+    negg = (jnp.broadcast_to(_KC["NEGG_X"], (LIMBS, S)),
+            jnp.broadcast_to(_KC["NEGG_Y"], (LIMBS, S)),
+            _G1ops.one_like(S))
+    pts = tuple(jnp.concatenate([a, b], axis=1)
+                for a, b in zip(acc, negg))
+    lo2 = jnp.concatenate([lo_ref[:], lo_ref[:]], axis=1)
+    hi2 = jnp.concatenate([hi_ref[:], hi_ref[:]], axis=1)
+    scaled = scalar_mul(_G1ops, pts, lo2, hi2)
+    zi = k_fq_inv(scaled[2])
+    xa = k_mont_mul(scaled[0], zi)
+    ya = k_mont_mul(scaled[1], zi)
+    g1_out_ref[:] = pack_planes([xa, ya])
+
+
+@partial(jax.jit, static_argnames=("K",))
+def prepare_kernel_call(pk_planes, kmask, lo, hi, *, K: int):
+    """pk (96, K·128) K-major blocks of projective G1 pubkeys; kmask
+    (1, K·128) int32; lo/hi (1, 128) uint32 RLC scalar words.
+
+    Returns (g1_aff (64, 256) blocks, ident_flags (1, 128) int32): lanes [0:128]
+    are the affine c_i·aggpk_i (pair them with H(m_i)), lanes [128:256] the
+    affine −c_i·G (pair them with σ_i) — the signature side of the RLC is
+    carried by the pairing bilinearity instead of a G2 ladder:
+    ∏ e(c_i·pk_i, H_i) · ∏ e(−c_i·G, σ_i) == 1.
+    """
+    S = PREP_S
+    if pk_planes.shape[1] != K * S:
+        raise ValueError("pk lanes must be K · 128")
+    return pl.pallas_call(
+        partial(_prepare_kernel, K=K),
+        in_specs=_const_specs() + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((2 * BLOCK_ROWS, 2 * S), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, S), jnp.int32)),
+    )(*_const_args(), pk_planes, kmask, lo, hi)

@@ -58,8 +58,13 @@ def _g2_limbs(point) -> bytes:
 
 
 @lru_cache(maxsize=1 << 14)
+def _h_point(message: bytes):
+    """Memoised hash-to-curve; both path-specific encodings derive from it."""
+    return hash_to_g2(message)
+
+
 def _h_limbs(message: bytes) -> bytes:
-    return LC.g2_to_limbs(hash_to_g2(message)).tobytes()
+    return LC.g2_to_limbs(_h_point(message)).tobytes()
 
 
 def _g1_arr(point) -> np.ndarray:
@@ -103,9 +108,105 @@ def _verify_sets_kernel(pk, kmask, sig, h, scal, smask):
     return ok & ~any_bad
 
 
+# ---------------------------------------------------------------------------
+# Pallas path (production TPU): prepare → miller → product → host final exp
+# ---------------------------------------------------------------------------
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@lru_cache(maxsize=1 << 16)
+def _g1_aff_col(point) -> bytes:
+    """Affine G1 → (64,) block-layout column (x at rows 0, y at 32)."""
+    col = np.zeros(64, np.uint32)
+    col[0:26] = LF.to_mont(point[0])
+    col[32:58] = LF.to_mont(point[1])
+    return col.tobytes()
+
+
+@lru_cache(maxsize=1 << 16)
+def _g2_aff_col(point) -> bytes:
+    """Affine G2 → (128,) block-layout column (x0/x1/y0/y1 at 0/32/64/96)."""
+    (x0, x1), (y0, y1) = point
+    col = np.zeros(128, np.uint32)
+    col[0:26] = LF.to_mont(x0)
+    col[32:58] = LF.to_mont(x1)
+    col[64:90] = LF.to_mont(y0)
+    col[96:122] = LF.to_mont(y1)
+    return col.tobytes()
+
+
+def _h_aff_col(message: bytes) -> bytes:
+    return _g2_aff_col(_h_point(message))
+
+
+def _lane_fq12(planes: np.ndarray, lane: int):
+    """(384, M) device blocks → host Fq12 tuple for one lane."""
+    c = [LF.from_mont(planes[i * 32:i * 32 + 26, lane]) for i in range(12)]
+    return (((c[0], c[1]), (c[2], c[3]), (c[4], c[5])),
+            ((c[6], c[7]), (c[8], c[9]), (c[10], c[11])))
+
+
+def _dispatch_pallas(entries, rand_fn) -> bool:
+    """Chunked device pipeline replicating ``_verify_sets_kernel`` semantics:
+
+        ∏ e(c_i·aggpk_i, H(m_i)) · ∏ e(−c_i·G, σ_i) == 1
+
+    (the signature side of the RLC rides the pairing bilinearity — no G2
+    ladder).  Each 128-set chunk runs the prepare kernel + one 256-lane
+    Miller launch; lane products land on the host for ONE shared
+    final exponentiation across the whole call.
+    """
+    from . import pairing_kernel as PK
+    from .pairing import final_exponentiation_cubed
+    from . import fields as F
+
+    S = PK.PREP_S
+    acc = F.FQ12_ONE
+    for base in range(0, len(entries), S):
+        chunk = entries[base:base + S]
+        n = len(chunk)
+        K = _next_pow2(max(len(e[1]) for e in chunk))
+        pk = np.zeros((96, K * S), np.uint32)
+        kmask = np.zeros((1, K * S), np.int32)
+        lo = np.zeros((1, S), np.uint32)
+        hi = np.zeros((1, S), np.uint32)
+        g2 = np.zeros((128, 2 * S), np.uint32)
+        lane_mask = np.zeros((1, 2 * S), np.int32)
+        one_col = np.zeros(26, np.uint32)
+        one_col[:] = np.asarray(LF.ONE_MONT)
+        for s, (sig_pt, keys, msg) in enumerate(chunk):
+            for k, kp in enumerate(keys):
+                col = k * S + s
+                pk[0:64, col] = np.frombuffer(_g1_aff_col(kp), np.uint32)
+                pk[64:90, col] = one_col  # projective Z = 1
+                kmask[0, col] = 1
+            c = rand_fn()
+            lo[0, s] = c & 0xFFFFFFFF
+            hi[0, s] = c >> 32
+            g2[:, s] = np.frombuffer(_h_aff_col(bytes(msg)), np.uint32)
+            lane_mask[0, s] = 1
+            if sig_pt is not None:
+                g2[:, S + s] = np.frombuffer(_g2_aff_col(sig_pt), np.uint32)
+                lane_mask[0, S + s] = 1
+        g1_aff, idflags = PK.prepare_kernel_call(
+            jnp.asarray(pk), jnp.asarray(kmask), jnp.asarray(lo),
+            jnp.asarray(hi), K=K)
+        if bool(np.asarray(idflags)[0, :n].any()):
+            return False  # a live set's aggregate pubkey is the identity
+        f = PK.miller_kernel_call(g1_aff, jnp.asarray(g2))
+        prod = np.asarray(PK.product_kernel_call(f, jnp.asarray(lane_mask)))
+        for lane in range(S):
+            acc = F.fq12_mul(acc, _lane_fq12(prod, lane))
+    return final_exponentiation_cubed(acc) == F.FQ12_ONE
+
+
 def _dispatch(entries, rand_fn) -> bool:
     """entries: list of (agg_sig_point | None meaning infinity is already
     rejected, [pubkey points], message bytes).  rand_fn() → 64-bit scalar."""
+    if _use_pallas():
+        return _dispatch_pallas(entries, rand_fn)
     S = _next_pow2(len(entries))
     K = _next_pow2(max(len(e[1]) for e in entries))
     pk = np.broadcast_to(_G1_IDENT, (S, K, 3, LF.LIMBS)).copy()

@@ -55,7 +55,6 @@ class IncrementalMerkleCache:
         self.depth = max((int(limit_chunks) - 1).bit_length(), 0)
         self.mixin_length = mixin_length
         self.levels: list[np.ndarray] | None = None
-        self.count = 0
 
     # -- internals -----------------------------------------------------------
 
@@ -123,7 +122,6 @@ class IncrementalMerkleCache:
             elif diff.size:
                 stored[diff] = padded[diff]
                 self._propagate(diff)
-        self.count = k
         root = self.levels[-1][0]
         lvl = len(self.levels) - 1
         while lvl < self.depth:
@@ -140,7 +138,6 @@ class IncrementalMerkleCache:
         out = IncrementalMerkleCache.__new__(IncrementalMerkleCache)
         out.depth = self.depth
         out.mixin_length = self.mixin_length
-        out.count = self.count
         out.levels = (None if self.levels is None
                       else [lv.copy() for lv in self.levels])
         return out

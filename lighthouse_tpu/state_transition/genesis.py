@@ -53,16 +53,24 @@ def interop_genesis_state(n_validators: int, genesis_time: int, preset, spec,
 
     reg = ValidatorRegistry(n_validators)
     reg._n = n_validators
+    pubs = np.zeros((n_validators, 48), dtype=np.uint8)
+    creds = np.zeros((n_validators, 32), dtype=np.uint8)
     for i in range(n_validators):
         pk = interop_pubkey(i)
-        reg.wcol("pubkey")[i] = np.frombuffer(pk, dtype=np.uint8)
-        reg.wcol("withdrawal_credentials")[i] = np.frombuffer(
-            bls_withdrawal_credentials(pk), dtype=np.uint8)
-    reg.wcol("effective_balance")[:] = preset.MAX_EFFECTIVE_BALANCE
-    reg.wcol("activation_eligibility_epoch")[:] = GENESIS_EPOCH
-    reg.wcol("activation_epoch")[:] = GENESIS_EPOCH
-    reg.wcol("exit_epoch")[:] = FAR_FUTURE_EPOCH
-    reg.wcol("withdrawable_epoch")[:] = FAR_FUTURE_EPOCH
+        pubs[i] = np.frombuffer(pk, dtype=np.uint8)
+        creds[i] = np.frombuffer(bls_withdrawal_credentials(pk),
+                                 dtype=np.uint8)
+    reg.init_columns(
+        pubkey=pubs,
+        withdrawal_credentials=creds,
+        effective_balance=np.full(n_validators, preset.MAX_EFFECTIVE_BALANCE,
+                                  dtype=np.uint64),
+        activation_eligibility_epoch=np.full(n_validators, GENESIS_EPOCH,
+                                             dtype=np.uint64),
+        activation_epoch=np.full(n_validators, GENESIS_EPOCH, dtype=np.uint64),
+        exit_epoch=np.full(n_validators, FAR_FUTURE_EPOCH, dtype=np.uint64),
+        withdrawable_epoch=np.full(n_validators, FAR_FUTURE_EPOCH,
+                                   dtype=np.uint64))
 
     scls = T.state_cls(fork)
     state = scls()

@@ -82,7 +82,8 @@ class RegistryCache:
                         self.stored[cname] = st
                     else:
                         st[idx] = col[idx]
-        reg._dirty_cols.clear()
+        # Row marks are consumed; column marks are sticky (a wcol view may
+        # be held and written later — the column is re-diffed every root).
         reg._dirty_rows.clear()
         return self.tree.root_words(self.record_roots, length=n)
 

@@ -100,6 +100,10 @@ class ValidatorRegistry:
         # (``cached_tree_hash``'s dirty leaves, at column/row granularity).
         # ``col()`` views are read-only so every write goes through ``wcol``/
         # ``set``/``append`` and is tracked — an unmarked write raises.
+        # ``_dirty_cols`` is STICKY: once a column has been exposed through
+        # ``wcol`` it stays marked for good, because the caller may hold the
+        # writable view across hash-cache consumptions; the cache re-diffs
+        # sticky columns every root (a vectorized compare, ~ms at 1M).
         self._dirty_cols: set = set(self._COLUMNS)
         self._dirty_rows: set = set()
 
@@ -148,6 +152,15 @@ class ValidatorRegistry:
     def __iter__(self):
         for i in range(self._n):
             yield self[i]
+
+    def init_columns(self, **arrays) -> None:
+        """Bulk-initialise columns on a FRESH registry (genesis fast path).
+        All columns start dirty, so no extra marking is needed; using this
+        instead of ``wcol`` avoids sticky-marking bulk-written columns."""
+        for name, arr in arrays.items():
+            if name not in self._COLUMNS:
+                raise KeyError(name)
+            getattr(self, "_" + name)[:self._n] = arr
 
     def set(self, i: int, v: Validator) -> None:
         if not 0 <= i < self._n:
@@ -252,8 +265,12 @@ class ValidatorRegistry:
         from ..ops.merkle import HOST_DISPATCH_THRESHOLD, hash64_host_words
         from ..ops.tree_cache import HASH_COUNT
         n = self._n
-        sel = np.arange(n) if indices is None else np.asarray(indices)
-        k = sel.shape[0]
+        if indices is None:
+            sel = slice(None, n)  # zero-copy column views for full builds
+            k = n
+        else:
+            sel = np.asarray(indices)
+            k = sel.shape[0]
         if k == 0:
             return np.zeros((0, 8), dtype=np.uint32)
         inner = (hash64_host_words if k <= HOST_DISPATCH_THRESHOLD

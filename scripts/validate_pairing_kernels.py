@@ -1,0 +1,71 @@
+import sys; sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+import time, numpy as np, jax, jax.numpy as jnp
+print("devices:", jax.devices(), flush=True)
+from lighthouse_tpu.crypto import curve as C, fields as F, pairing as HP
+from lighthouse_tpu.crypto import limb_field as LF, limb_tower as LT
+from lighthouse_tpu.crypto import pairing_kernel as PK
+
+def g1_planes(pts, M):
+    out = np.zeros((64, M), np.uint32)
+    for i, p in enumerate(pts):
+        out[0:26, i] = LF.to_mont(p[0]); out[32:58, i] = LF.to_mont(p[1])
+    return out
+
+def g2_planes(pts, M):
+    out = np.zeros((128, M), np.uint32)
+    for i, p in enumerate(pts):
+        (x0, x1), (y0, y1) = p
+        out[0:26, i] = LF.to_mont(x0); out[32:58, i] = LF.to_mont(x1)
+        out[64:90, i] = LF.to_mont(y0); out[96:122, i] = LF.to_mont(y1)
+    return out
+
+def lane_fq12(fpl, lane):
+    c = [LF.from_mont(np.asarray(fpl[i*32:i*32+26, lane])) for i in range(12)]
+    return (((c[0], c[1]), (c[2], c[3]), (c[4], c[5])),
+            ((c[6], c[7]), (c[8], c[9]), (c[10], c[11])))
+
+M = 128
+p1 = [C.g1_mul(C.G1_GEN, 100 + i) for i in range(3)]
+q2 = [C.g2_mul(C.G2_GEN, 200 + i) for i in range(3)]
+g1p = jnp.asarray(g1_planes(p1 + [p1[0]]*(M-3), M))
+g2p = jnp.asarray(g2_planes(q2 + [q2[0]]*(M-3), M))
+t0 = time.time()
+fpl = PK.miller_kernel_call(g1p, g2p); fpl.block_until_ready()
+print("miller compile+run:", round(time.time()-t0, 2), flush=True)
+t0 = time.time()
+fpl = PK.miller_kernel_call(g1p, g2p); fpl.block_until_ready()
+print("miller 2nd (M=128):", round((time.time()-t0)*1000, 1), "ms", flush=True)
+g1p2 = jnp.concatenate([g1p, g1p], axis=1)
+g2p2 = jnp.concatenate([g2p, g2p], axis=1)
+t0 = time.time()
+f2 = PK.miller_kernel_call(g1p2, g2p2); f2.block_until_ready()
+print("miller M=256 compile+run:", round(time.time()-t0, 2), flush=True)
+t0 = time.time()
+f2 = PK.miller_kernel_call(g1p2, g2p2); f2.block_until_ready()
+print("miller 2nd (M=256):", round((time.time()-t0)*1000, 1), "ms", flush=True)
+
+# correctness: final-exp(cubed) of lane i vs host oracle
+fnp = np.asarray(fpl)
+for i in range(3):
+    dev_f = lane_fq12(fnp, i)
+    got = F.fq12_pow(HP.final_exponentiation(dev_f), 3)
+    want = F.fq12_pow(HP.pairing(p1[i], q2[i]), 3)
+    assert got == want, f"lane {i} mismatch"
+print("miller lanes match host oracle (x3)", flush=True)
+
+# product kernel: lanes [pa,pn] * 126 masked → product over classes
+pa = C.g1_mul(C.G1_GEN, 111); qb = C.g2_mul(C.G2_GEN, 222)
+pn = C.g1_neg(C.g1_mul(C.G1_GEN, 111*222))
+g1c = jnp.asarray(g1_planes([pa, pn] + [pa]*(M-2), M))
+g2c = jnp.asarray(g2_planes([qb, C.G2_GEN] + [qb]*(M-2), M))
+fc = PK.miller_kernel_call(g1c, g2c)
+mask = np.zeros((1, M), np.int32); mask[0, :2] = 1
+t0 = time.time()
+prod = PK.product_kernel_call(fc, jnp.asarray(mask)); prod.block_until_ready()
+print("product kernel compile+run:", round(time.time()-t0, 2), flush=True)
+pnp = np.asarray(prod)
+acc = F.FQ12_ONE
+for i in range(128):
+    acc = F.fq12_mul(acc, lane_fq12(pnp, i))
+assert HP.final_exponentiation(acc) == F.FQ12_ONE, "product != 1 after final exp"
+print("bilinear product check OK", flush=True)

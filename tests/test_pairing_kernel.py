@@ -1,0 +1,96 @@
+"""Pallas pairing kernels vs the host oracle — requires a real TPU.
+
+The default suite runs on the CPU backend where Mosaic cannot lower these
+kernels (and interpret mode would take hours), so everything here is
+skipped unless the session's jax default backend is a TPU.  On TPU this is
+the authoritative validation of the production BLS verify path
+(`scripts/validate_pairing_kernels.py` wraps it for ad-hoc runs).
+"""
+
+import numpy as np
+import pytest
+import jax
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="pallas pairing kernels need a real TPU (Mosaic)")
+
+
+def _g1_planes(pts, M):
+    from lighthouse_tpu.crypto import limb_field as LF
+    out = np.zeros((64, M), np.uint32)
+    for i, p in enumerate(pts):
+        out[0:26, i] = LF.to_mont(p[0])
+        out[32:58, i] = LF.to_mont(p[1])
+    return out
+
+
+def _g2_planes(pts, M):
+    from lighthouse_tpu.crypto import limb_field as LF
+    out = np.zeros((128, M), np.uint32)
+    for i, p in enumerate(pts):
+        (x0, x1), (y0, y1) = p
+        out[0:26, i] = LF.to_mont(x0)
+        out[32:58, i] = LF.to_mont(x1)
+        out[64:90, i] = LF.to_mont(y0)
+        out[96:122, i] = LF.to_mont(y1)
+    return out
+
+
+def test_miller_kernel_matches_host_oracle():
+    import jax.numpy as jnp
+    from lighthouse_tpu.crypto import curve as C, fields as F, pairing as HP
+    from lighthouse_tpu.crypto import pairing_kernel as PK
+    from lighthouse_tpu.crypto.tpu_backend import _lane_fq12
+
+    M = 128
+    p1 = [C.g1_mul(C.G1_GEN, 100 + i) for i in range(3)]
+    q2 = [C.g2_mul(C.G2_GEN, 200 + i) for i in range(3)]
+    f = np.asarray(PK.miller_kernel_call(
+        jnp.asarray(_g1_planes(p1 + [p1[0]] * (M - 3), M)),
+        jnp.asarray(_g2_planes(q2 + [q2[0]] * (M - 3), M))))
+    for i in range(3):
+        got = F.fq12_pow(HP.final_exponentiation(_lane_fq12(f, i)), 3)
+        want = F.fq12_pow(HP.pairing(p1[i], q2[i]), 3)
+        assert got == want, f"lane {i}"
+
+
+def test_tpu_backend_pallas_path():
+    from lighthouse_tpu.crypto import bls, curve as C
+    from lighthouse_tpu.crypto import tpu_backend as TB
+
+    assert TB._use_pallas()
+    tpu = bls._BACKENDS["tpu"]
+    sks = [bls.SecretKey(1000 + i) for i in range(4)]
+    pks = [k.public_key() for k in sks]
+    ma, mb = b"message-a", b"message-b"
+
+    sig = sks[0].sign(ma)
+    assert tpu.verify(sig, [pks[0]], ma)
+    assert not tpu.verify(sig, [pks[0]], mb)
+    assert not tpu.verify(sig, [pks[1]], ma)
+
+    agg = bls.aggregate_signatures([k.sign(ma) for k in sks])
+    assert tpu.verify(agg, pks, ma)
+    assert not tpu.verify(agg, pks[:3], ma)
+
+    agg2 = bls.aggregate_signatures([sks[0].sign(ma), sks[1].sign(mb)])
+    assert tpu.aggregate_verify(agg2, [pks[0], pks[1]], [ma, mb])
+    assert not tpu.aggregate_verify(agg2, [pks[1], pks[0]], [ma, mb])
+    assert not tpu.aggregate_verify(agg2, [], [])
+
+    sets = [
+        bls.SignatureSet(agg, list(pks), ma),
+        bls.SignatureSet(sks[2].sign(mb), [pks[2]], mb),
+        bls.SignatureSet(sks[3].sign(mb), [pks[3]], mb),
+    ]
+    assert tpu.verify_signature_sets(sets)
+    assert not tpu.verify_signature_sets(
+        sets[:2] + [bls.SignatureSet(sks[3].sign(mb), [pks[0]], mb)])
+    neg_pk = bls.PublicKey(C.g1_neg(pks[0].point))
+    assert not tpu.verify_signature_sets(
+        [bls.SignatureSet(agg, [pks[0], neg_pk], ma)])
+    assert not tpu.verify_signature_sets([])
+    assert not tpu.verify_signature_sets(
+        [bls.SignatureSet(bls.Signature(None), [pks[0]], ma)])
+    assert not tpu.verify_signature_sets([bls.SignatureSet(agg, [], ma)])
