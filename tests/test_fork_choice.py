@@ -1,0 +1,177 @@
+"""Proto-array fork choice: LMD-GHOST votes, FFG filtering, boost, pruning.
+
+Mirrors the reference's `proto_array_fork_choice.rs` votes/ffg test
+scenarios and `fork_choice.rs` behaviours (queued attestations, proposer
+boost reset, equivocation, invalidation), plus a harness-driven chain test.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.fork_choice import (
+    EXEC_OPTIMISTIC,
+    ForkChoice,
+    ProtoArrayError,
+    ProtoArrayForkChoice,
+)
+from lighthouse_tpu.fork_choice.proto_array import ZERO_ROOT
+
+
+def root(i: int) -> bytes:
+    return bytes([i]) + b"\x00" * 31
+
+
+def make_array(chain=((1, 0),)) -> ProtoArrayForkChoice:
+    """Build a tree from (node, parent) byte-ids; node 0 = genesis."""
+    pa = ProtoArrayForkChoice()
+    pa.on_block(slot=0, root=root(0), parent_root=ZERO_ROOT,
+                state_root=root(0), justified_epoch=1, justified_root=root(0),
+                finalized_epoch=1, finalized_root=root(0))
+    for node, parent in chain:
+        pa.on_block(slot=node, root=root(node), parent_root=root(parent),
+                    state_root=root(node), justified_epoch=1,
+                    justified_root=root(0), finalized_epoch=1,
+                    finalized_root=root(0))
+    return pa
+
+
+def head_of(pa: ProtoArrayForkChoice, balances) -> bytes:
+    deltas = pa.compute_deltas(np.asarray(balances, np.uint64))
+    pa.apply_score_changes(deltas, (1, root(0)), (1, root(0)),
+                           ZERO_ROOT, 0, 10)
+    return pa.find_head(root(0), 10)
+
+
+def test_no_votes_tie_breaks_by_root():
+    # Fork: 0 → 1, 0 → 2; no votes → higher root wins (proto_array.rs
+    # tie-break `child.root >= best_child.root`).
+    pa = make_array([(1, 0), (2, 0)])
+    assert head_of(pa, [0, 0, 0]) == root(2)
+
+
+def test_votes_pick_heavier_branch_and_move():
+    pa = make_array([(1, 0), (2, 0)])
+    pa.process_attestation(0, root(1), 1)
+    pa.process_attestation(1, root(1), 1)
+    pa.process_attestation(2, root(2), 1)
+    assert head_of(pa, [10, 10, 10]) == root(1)
+    # Two validators re-vote with a later epoch → branch 2 wins.
+    pa.process_attestation(0, root(2), 2)
+    pa.process_attestation(1, root(2), 2)
+    assert head_of(pa, [10, 10, 10]) == root(2)
+    # A stale-epoch vote does not override.
+    pa.process_attestation(0, root(1), 1)
+    assert head_of(pa, [10, 10, 10]) == root(2)
+
+
+def test_balance_changes_reweigh_branches():
+    pa = make_array([(1, 0), (2, 0)])
+    pa.process_attestation(0, root(1), 1)
+    pa.process_attestation(1, root(2), 1)
+    assert head_of(pa, [10, 5]) == root(1)
+    assert head_of(pa, [10, 50]) == root(2)
+
+
+def test_deep_chain_weight_propagates():
+    # 0 → 1 → 3; 0 → 2; one vote deep on 3 outweighs one on 2 + tie-break.
+    pa = make_array([(1, 0), (2, 0), (3, 1)])
+    pa.process_attestation(0, root(3), 1)
+    pa.process_attestation(1, root(2), 1)
+    assert head_of(pa, [20, 10]) == root(3)
+
+
+def test_ffg_filter_excludes_mismatched_justification():
+    pa = make_array([(1, 0), (2, 0)])
+    # Node 2 disagrees on justification → not viable despite weight.
+    pa.nodes[pa.indices[root(2)]].justified_epoch = 9
+    pa.process_attestation(0, root(2), 1)
+    assert head_of(pa, [100]) == root(1)
+
+
+def test_proposer_boost_flips_then_resets():
+    pa = make_array([(1, 0), (2, 0)])
+    pa.process_attestation(0, root(1), 1)
+    deltas = pa.compute_deltas(np.asarray([10], np.uint64))
+    pa.apply_score_changes(deltas, (1, root(0)), (1, root(0)),
+                           root(2), 100, 10)
+    assert pa.find_head(root(0), 10) == root(2)
+    # Next call without the boost removes the previous boost score.
+    deltas = pa.compute_deltas(np.asarray([10], np.uint64))
+    pa.apply_score_changes(deltas, (1, root(0)), (1, root(0)),
+                           ZERO_ROOT, 0, 11)
+    assert pa.find_head(root(0), 11) == root(1)
+
+
+def test_equivocation_removes_weight():
+    pa = make_array([(1, 0), (2, 0)])
+    pa.process_attestation(0, root(1), 1)
+    pa.process_attestation(1, root(2), 1)
+    assert head_of(pa, [100, 10]) == root(1)
+    pa.process_equivocation(0)
+    assert head_of(pa, [100, 10]) == root(2)
+
+
+def test_invalid_payload_zeroes_subtree():
+    pa = make_array([(1, 0), (2, 0), (3, 1)])
+    for n in (1, 2, 3):
+        pa.nodes[pa.indices[root(n)]].execution_status = EXEC_OPTIMISTIC
+    pa.process_attestation(0, root(3), 1)
+    assert head_of(pa, [50]) == root(3)
+    pa.on_invalid_execution_payload(root(1))
+    assert head_of(pa, [50]) == root(2)
+
+
+def test_prune_remaps_votes_and_indices():
+    pa = make_array([(1, 0), (2, 1), (3, 2), (4, 3)])
+    pa.prune_threshold = 1
+    pa.process_attestation(0, root(4), 1)
+    assert head_of(pa, [10]) == root(4)
+    pa.maybe_prune(root(2))
+    assert root(0) not in pa.indices and root(1) not in pa.indices
+    deltas = pa.compute_deltas(np.asarray([10], np.uint64))
+    pa.apply_score_changes(deltas, (1, root(0)), (1, root(0)),
+                           ZERO_ROOT, 0, 10)
+    assert pa.find_head(root(2), 10) == root(4)
+
+
+def test_fork_choice_follows_harness_chain():
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+    from lighthouse_tpu.state_transition.helpers import compute_epoch_at_slot
+
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        # Canonical genesis block root: header with the state root
+        # backfilled (what process_slot writes into block_roots).
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        genesis_root = hdr.tree_hash_root()
+        fc = ForkChoice(h.preset, h.spec, genesis_root=genesis_root,
+                        genesis_state=h.state.copy())
+        for _ in range(4):
+            signed = h.build_block()
+            h.apply_block(signed)
+            block_root = signed.message.tree_hash_root()
+            fc.on_tick(int(signed.message.slot))
+            fc.on_block(signed, block_root, h.state.copy(), is_timely=True)
+            # votes: every attestation in the block, as indexed messages
+            from lighthouse_tpu.state_transition.committees import (
+                get_beacon_committee)
+            for att in signed.message.body.attestations:
+                committee = get_beacon_committee(
+                    h.state, int(att.data.slot), int(att.data.index),
+                    h.preset)
+                bits = np.asarray(att.aggregation_bits, dtype=bool)
+                indices = np.asarray(committee)[bits[:len(committee)]]
+                fc.on_attestation(_Indexed(att.data, indices.tolist()))
+            assert fc.get_head() == block_root
+    finally:
+        B.set_backend("python")
+
+
+class _Indexed:
+    def __init__(self, data, indices):
+        self.data = data
+        self.attesting_indices = indices
