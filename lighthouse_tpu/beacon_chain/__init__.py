@@ -1,0 +1,35 @@
+"""Chain runtime: staged verification pipelines + the BeaconChain
+orchestrator (counterpart of ``beacon_node/beacon_chain``,
+``/root/reference/beacon_node/beacon_chain/src/``)."""
+
+from .chain import BeaconChain, CanonicalHead
+from .block_verification import (
+    ExecutedBlock,
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+)
+from .attestation_verification import (
+    VerifiedAttestation,
+    batch_verify_attestations,
+)
+from .errors import (
+    AttestationError,
+    BlockError,
+    BlockIsAlreadyKnown,
+    FutureSlot,
+    IncorrectProposer,
+    InvalidSignatures,
+    ParentUnknown,
+    ProposalSignatureInvalid,
+    RepeatProposal,
+    StateRootMismatch,
+)
+
+__all__ = [
+    "BeaconChain", "CanonicalHead", "GossipVerifiedBlock",
+    "SignatureVerifiedBlock", "ExecutedBlock", "VerifiedAttestation",
+    "batch_verify_attestations", "BlockError", "AttestationError",
+    "BlockIsAlreadyKnown", "FutureSlot", "ParentUnknown",
+    "IncorrectProposer", "ProposalSignatureInvalid", "InvalidSignatures",
+    "StateRootMismatch", "RepeatProposal",
+]

@@ -1,0 +1,102 @@
+"""Batched gossip-attestation verification —
+``/root/reference/beacon_node/beacon_chain/src/attestation_verification.rs``
+and its batch module (``attestation_verification/batch.rs:31-120``).
+
+The batching window (≤64 per worker batch,
+``beacon_processor/mod.rs:200``) is the natural device batch: every
+attestation passes the cheap checks individually (slot window, known head,
+committee resolution, first-seen dedup), then ALL signatures verify in ONE
+``verify_signature_sets`` call — on TPU one fused kernel pipeline.  If the
+batch fails, each attestation re-verifies individually so one bad item
+cannot censor the rest (``batch.rs:203``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..crypto import bls
+from ..state_transition import signature_sets as sigs
+from ..state_transition.committees import get_beacon_committee
+from .errors import (
+    AttestationError,
+    AttestationSlotOutOfWindow,
+    AttestationSignatureInvalid,
+    PriorAttestationKnown,
+    UnknownHeadBlock,
+)
+
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32
+
+
+@dataclass
+class VerifiedAttestation:
+    """Attestation + resolved committee/indices, post-verification."""
+    attestation: object
+    indexed_indices: np.ndarray
+    committee: np.ndarray
+
+
+def _cheap_checks(chain, att) -> Tuple[np.ndarray, np.ndarray]:
+    """Slot window, known head, committee resolution, dedup.
+    Returns (attesting indices, committee)."""
+    slot = int(att.data.slot)
+    cur = chain.current_slot()
+    if not (slot <= cur <= slot + ATTESTATION_PROPAGATION_SLOT_RANGE):
+        raise AttestationSlotOutOfWindow(f"slot {slot}, current {cur}")
+    head_root = bytes(att.data.beacon_block_root)
+    if not chain.fork_choice.contains_block(head_root):
+        raise UnknownHeadBlock(head_root.hex())
+    state = chain.state_for_attestation(att)
+    committee = np.asarray(get_beacon_committee(
+        state, slot, int(att.data.index), chain.preset))
+    bits = np.asarray(att.aggregation_bits, dtype=bool)[:len(committee)]
+    indices = committee[bits]
+    epoch = int(att.data.target.epoch)
+    fresh = [i for i in indices
+             if chain.observed_attesters.observe(epoch, int(i))]
+    if not fresh:
+        raise PriorAttestationKnown(
+            f"all {len(indices)} attesters already seen for epoch {epoch}")
+    return indices, committee
+
+
+def _signature_set(chain, att, indices) -> bls.SignatureSet:
+    state = chain.state_for_attestation(att)
+    return sigs.indexed_attestation_signature_set(
+        state, [int(i) for i in indices], bytes(att.signature), att.data,
+        chain.pubkey_cache, chain.preset)
+
+
+def batch_verify_attestations(chain, attestations: List
+                              ) -> List[Tuple[object, Optional[Exception]]]:
+    """One batched signature verify for the window; individual fallback on
+    batch failure.  Returns per-attestation (VerifiedAttestation | None,
+    error | None) preserving order."""
+    staged = []
+    results: List = [None] * len(attestations)
+    for i, att in enumerate(attestations):
+        try:
+            indices, committee = _cheap_checks(chain, att)
+            staged.append((i, att, indices, committee))
+        except AttestationError as e:
+            results[i] = (None, e)
+    if staged:
+        sets = [_signature_set(chain, att, idx)
+                for (_, att, idx, _) in staged]
+        if bls.verify_signature_sets(sets):
+            for (i, att, idx, committee) in staged:
+                results[i] = (VerifiedAttestation(att, idx, committee), None)
+        else:
+            # Fallback: verify one-by-one (`batch.rs:203`).
+            for (i, att, idx, committee), sset in zip(staged, sets):
+                if bls.verify_signature_sets([sset]):
+                    results[i] = (VerifiedAttestation(att, idx, committee),
+                                  None)
+                else:
+                    results[i] = (None, AttestationSignatureInvalid(
+                        f"attestation {i} signature invalid"))
+    return results

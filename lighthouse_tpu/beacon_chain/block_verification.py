@@ -1,0 +1,134 @@
+"""Staged block verification — the import pipeline of
+``/root/reference/beacon_node/beacon_chain/src/block_verification.rs``.
+
+Stages (each a type, holding everything the next stage needs):
+
+1. :class:`GossipVerifiedBlock` (``block_verification.rs:594``) — cheap
+   structural checks (slot window, dedup, parent seen, expected proposer)
+   plus ONE pairing: the proposer signature.
+2. :class:`SignatureVerifiedBlock` (``:988``) — every other signature in
+   the block accumulated and bulk-verified in one batched call (the
+   ``BlockSignatureVerifier`` funnel, which on TPU is one fused device
+   program).
+3. :class:`ExecutionPendingBlock` (``:1104``) — full state transition with
+   signatures off (already proven), post-state root check, payload
+   verification through the execution-layer seam.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..crypto import bls
+from ..state_transition import SignatureStrategy, state_transition
+from ..state_transition.committees import get_beacon_proposer_index
+from ..state_transition.per_block import SigAccumulator, process_block
+from ..state_transition.per_slot import process_slots
+from ..state_transition import signature_sets as sigs
+from .errors import (
+    BlockIsAlreadyKnown,
+    FutureSlot,
+    IncorrectProposer,
+    InvalidSignatures,
+    ParentUnknown,
+    ProposalSignatureInvalid,
+    RepeatProposal,
+    StateRootMismatch,
+)
+
+
+@dataclass
+class GossipVerifiedBlock:
+    signed_block: object
+    block_root: bytes
+    parent_state: object  # parent post-state advanced to the block slot
+
+    @classmethod
+    def new(cls, chain, signed_block) -> "GossipVerifiedBlock":
+        block = signed_block.message
+        slot = int(block.slot)
+        if slot > chain.current_slot():
+            raise FutureSlot(f"block slot {slot} > current {chain.current_slot()}")
+        block_root = block.tree_hash_root()
+        if chain.fork_choice.contains_block(block_root):
+            raise BlockIsAlreadyKnown(block_root.hex())
+        parent_root = bytes(block.parent_root)
+        if not chain.fork_choice.contains_block(parent_root):
+            raise ParentUnknown(parent_root.hex())
+        # Proposer-equivocation guard, peek only — recorded after the
+        # signature check (`observed_block_producers.rs` two-phase).
+        proposer = int(block.proposer_index)
+        if chain.observed_block_producers.has_been_observed(slot, proposer):
+            raise RepeatProposal(f"proposer {proposer} already proposed at "
+                                 f"slot {slot}")
+        # Advance the parent state to the block slot for committee checks
+        # (`cheap_state_advance_to_obtain_committees`).
+        state = chain.state_at_block_root(parent_root)
+        if int(state.slot) < slot:
+            state = process_slots(state, slot, chain.preset, chain.spec,
+                                  chain.T)
+        expected = get_beacon_proposer_index(state, chain.preset, slot=slot)
+        if proposer != expected:
+            raise IncorrectProposer(f"got {proposer}, expected {expected}")
+        # One pairing: the proposal signature
+        # (`block_verification.rs:594` signature_verify only proposal).
+        cache = chain.pubkey_cache
+        pset = sigs.block_proposal_signature_set(
+            state, signed_block, cache, chain.preset,
+            block_root=block_root)
+        if not bls.verify_signature_sets([pset]):
+            raise ProposalSignatureInvalid(block_root.hex())
+        chain.observed_block_producers.observe(slot, proposer)
+        return cls(signed_block=signed_block, block_root=block_root,
+                   parent_state=state)
+
+
+@dataclass
+class SignatureVerifiedBlock:
+    signed_block: object
+    block_root: bytes
+    parent_state: object
+
+    @classmethod
+    def from_gossip_verified(cls, chain,
+                             g: GossipVerifiedBlock) -> "SignatureVerifiedBlock":
+        """Stage marker: the remaining signatures are accumulated DURING
+        execution and bulk-verified in one batched call
+        (`block_signature_verifier.rs:160-405` — the execution stage runs
+        with ``VERIFY_BULK`` so the transition is performed exactly once)."""
+        return cls(signed_block=g.signed_block, block_root=g.block_root,
+                   parent_state=g.parent_state)
+
+
+@dataclass
+class ExecutedBlock:
+    signed_block: object
+    block_root: bytes
+    post_state: object
+
+    @classmethod
+    def from_signature_verified(cls, chain,
+                                sv: SignatureVerifiedBlock) -> "ExecutedBlock":
+        """`ExecutionPendingBlock::from_signature_verified_components`
+        (`block_verification.rs:1104`): one transition with ``VERIFY_BULK``
+        (non-proposal signatures batched into one device verify during
+        execution), then the post-state root check (`:1423`)."""
+        block = sv.signed_block.message
+        state = sv.parent_state
+        try:
+            fork = chain.spec.fork_name_at_epoch(
+                int(state.slot) // chain.preset.SLOTS_PER_EPOCH)
+            process_block(state, sv.signed_block, fork, chain.preset,
+                          chain.spec, chain.T,
+                          strategy=SignatureStrategy.VERIFY_BULK,
+                          pubkey_cache=chain.pubkey_cache,
+                          payload_verifier=chain.payload_verifier)
+        except Exception as e:
+            raise InvalidSignatures(f"state transition failed: {e}") from e
+        root = state.tree_hash_root()
+        if root != bytes(block.state_root):
+            raise StateRootMismatch(
+                f"{root.hex()} != {bytes(block.state_root).hex()}")
+        return cls(signed_block=sv.signed_block, block_root=sv.block_root,
+                   post_state=state)

@@ -1,0 +1,223 @@
+"""The chain orchestrator — ``BeaconChain``
+(``/root/reference/beacon_node/beacon_chain/src/beacon_chain.rs``).
+
+Holds the store, fork choice, op pool, slot clock and observation caches;
+drives the staged block pipeline (``process_block`` —
+``beacon_chain.rs:2599``), the batched attestation path
+(``apply_attestation_to_fork_choice`` — ``:1858``), head recomputation
+(``canonical_head.rs`` — an immutable cached snapshot so readers never
+lock), block production from the op pool (``produce_block`` — ``:3526``)
+and the per-slot task (``:5322``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from ..fork_choice import ForkChoice
+from ..op_pool import OperationPool
+from ..state_transition import signature_sets as sigs
+from ..state_transition.committees import get_beacon_proposer_index
+from ..state_transition.per_slot import process_slots
+from ..store import DBColumn, HotColdDB
+from .attestation_verification import batch_verify_attestations
+from .block_verification import (
+    ExecutedBlock,
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+)
+from .errors import BlockError
+from .observed import (
+    ObservedAggregators,
+    ObservedAttesters,
+    ObservedBlockProducers,
+)
+
+
+@dataclass
+class CanonicalHead:
+    """Immutable head snapshot (`canonical_head.rs:85-238`): hot readers
+    never take the fork-choice lock."""
+    root: bytes
+    slot: int
+    state: object
+
+
+class BeaconChain:
+    """Single-process chain runtime."""
+
+    def __init__(self, *, store: HotColdDB, genesis_state, genesis_block_root,
+                 preset, spec, T, slot_clock=None):
+        self.store = store
+        self.preset = preset
+        self.spec = spec
+        self.T = T
+        self.slot_clock = slot_clock
+        self.pubkey_cache = sigs.PubkeyCache()
+        self.op_pool = OperationPool(preset, spec)
+        self.observed_attesters = ObservedAttesters()
+        self.observed_aggregators = ObservedAggregators()
+        self.observed_block_producers = ObservedBlockProducers()
+        self.payload_verifier = None  # execution-layer seam
+        self.fork_choice = ForkChoice(
+            preset, spec, genesis_root=genesis_block_root,
+            genesis_state=genesis_state.copy())
+        genesis_state_root = genesis_state.tree_hash_root()
+        store.put_state(genesis_state_root, genesis_state.copy(),
+                        genesis_block_root)
+        self._states_by_block: dict[bytes, object] = {
+            genesis_block_root: genesis_state.copy()}
+        self.head = CanonicalHead(root=genesis_block_root,
+                                  slot=int(genesis_state.slot),
+                                  state=genesis_state.copy())
+
+    # -- time ----------------------------------------------------------------
+
+    def current_slot(self) -> int:
+        if self.slot_clock is not None:
+            return self.slot_clock.now()
+        return self.fork_choice.current_slot
+
+    def per_slot_task(self, slot: int) -> None:
+        """`timer` service hook (`beacon_chain.rs:5322`)."""
+        self.fork_choice.on_tick(slot)
+        self.observed_attesters.prune(slot // self.preset.SLOTS_PER_EPOCH)
+        self.observed_block_producers.prune(slot)
+
+    # -- state lookup --------------------------------------------------------
+
+    def state_at_block_root(self, block_root: bytes):
+        """Post-state of an imported block (snapshot cache role,
+        `snapshot_cache.rs`), falling back to the store."""
+        state = self._states_by_block.get(block_root)
+        if state is not None:
+            return state.copy()
+        block = self.store.get_block(block_root)
+        if block is None:
+            raise BlockError(f"unknown block {block_root.hex()}")
+        state = self.store.get_state(bytes(block.message.state_root))
+        if state is None:
+            raise BlockError("state unavailable for block")
+        return state
+
+    def state_for_attestation(self, att):
+        """A state able to compute the attestation's committee — the head
+        state advanced if needed (shuffling/attester cache role)."""
+        state = self.head.state
+        slot = int(att.data.slot)
+        if int(state.slot) < slot:
+            state = process_slots(state.copy(), slot, self.preset, self.spec,
+                                  self.T)
+        return state
+
+    # -- block import pipeline ----------------------------------------------
+
+    def process_block(self, signed_block, *, is_timely: bool = False) -> bytes:
+        """Full pipeline: gossip → bulk signatures → execution → fork
+        choice import → persistence → head update.  Returns the block root
+        (`beacon_chain.rs:2599` + `import_execution_pending_block:2679`)."""
+        g = GossipVerifiedBlock.new(self, signed_block)
+        sv = SignatureVerifiedBlock.from_gossip_verified(self, g)
+        ex = ExecutedBlock.from_signature_verified(self, sv)
+        self._import_block(ex, is_timely=is_timely)
+        return ex.block_root
+
+    def _import_block(self, ex: ExecutedBlock, *, is_timely: bool) -> None:
+        block_root = ex.block_root
+        state = ex.post_state
+        state_root = bytes(ex.signed_block.message.state_root)
+        self.store.put_block(block_root, ex.signed_block)
+        self.store.put_state(state_root, state.copy(), block_root)
+        self.fork_choice.on_block(ex.signed_block, block_root, state,
+                                  is_timely=is_timely)
+        self._states_by_block[block_root] = state
+        # Feed block attestations to fork choice (`beacon_chain.rs:
+        # apply_attestation_to_fork_choice` via import).
+        for att in ex.signed_block.message.body.attestations:
+            try:
+                from ..state_transition.committees import get_beacon_committee
+                committee = np.asarray(get_beacon_committee(
+                    state, int(att.data.slot), int(att.data.index),
+                    self.preset))
+                bits = np.asarray(att.aggregation_bits,
+                                  dtype=bool)[:len(committee)]
+                self.fork_choice.on_attestation(_Indexed(
+                    att.data, committee[bits].tolist()), is_from_block=True)
+            except Exception:
+                pass  # block attestations are best-effort for fork choice
+        self.recompute_head()
+        # Finalization housekeeping: prune pool + migrate store.
+        fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
+        if fin_root != b"\x00" * 32 and self.fork_choice.contains_block(fin_root):
+            fin_slot = self.fork_choice.proto.nodes[
+                self.fork_choice.proto.indices[fin_root]].slot
+            self.store.migrate_to_cold(fin_slot, fin_root)
+            for root in [r for r, s in self._states_by_block.items()
+                         if int(s.slot) < fin_slot - 1]:
+                del self._states_by_block[root]
+        self.op_pool.prune(state)
+
+    def recompute_head(self) -> bytes:
+        """`recompute_head` (`canonical_head.rs`)."""
+        head_root = self.fork_choice.get_head()
+        if head_root != self.head.root:
+            state = self.state_at_block_root(head_root)
+            self.head = CanonicalHead(root=head_root,
+                                      slot=int(state.slot), state=state)
+        return self.head.root
+
+    # -- attestations --------------------------------------------------------
+
+    def process_attestation_batch(self, attestations: List) -> List:
+        """Gossip batch → one device verify → fork choice + op pool
+        (`attestation_verification/batch.rs` + `beacon_chain.rs:1858`)."""
+        results = batch_verify_attestations(self, attestations)
+        for verified, err in results:
+            if verified is None:
+                continue
+            try:
+                self.fork_choice.on_attestation(_Indexed(
+                    verified.attestation.data,
+                    [int(i) for i in verified.indexed_indices]))
+            except Exception:
+                pass
+            self.op_pool.insert_attestation(verified.attestation,
+                                            verified.committee)
+        return results
+
+    # -- production ----------------------------------------------------------
+
+    def produce_block_on_state(self, state, slot: int, randao_reveal: bytes,
+                               graffiti: bytes = b"") -> object:
+        """Assemble an unsigned block from the op pool
+        (`produce_block_on_state`, `beacon_chain.rs:4133`)."""
+        if int(state.slot) < slot:
+            state = process_slots(state.copy(), slot, self.preset, self.spec,
+                                  self.T)
+        fork = self.spec.fork_name_at_epoch(slot // self.preset.SLOTS_PER_EPOCH)
+        proposer = get_beacon_proposer_index(state, self.preset, slot=slot)
+        atts = self.op_pool.get_attestations(state, self.T)
+        proposer_slashings, attester_slashings, exits = \
+            self.op_pool.get_slashings_and_exits(state)
+        changes = self.op_pool.get_bls_to_execution_changes(state)
+        return dict(
+            slot=slot, proposer_index=proposer,
+            parent_root=self.head.root,
+            attestations=atts,
+            proposer_slashings=proposer_slashings,
+            attester_slashings=attester_slashings,
+            voluntary_exits=exits,
+            bls_to_execution_changes=changes,
+            randao_reveal=randao_reveal,
+            graffiti=graffiti,
+            state=state,
+        )
+
+
+class _Indexed:
+    def __init__(self, data, indices):
+        self.data = data
+        self.attesting_indices = indices

@@ -1,0 +1,66 @@
+"""Block/attestation rejection reasons — the typed error surface of the
+verification pipelines (``BlockError`` in
+``/root/reference/beacon_node/beacon_chain/src/block_verification.rs:95``
+and ``Error`` in ``attestation_verification.rs``)."""
+
+from __future__ import annotations
+
+
+class BlockError(ValueError):
+    """Base class; subclasses say which pipeline stage rejected."""
+
+
+class BlockIsAlreadyKnown(BlockError):
+    pass
+
+
+class FutureSlot(BlockError):
+    pass
+
+
+class ParentUnknown(BlockError):
+    pass
+
+
+class IncorrectProposer(BlockError):
+    pass
+
+
+class ProposalSignatureInvalid(BlockError):
+    pass
+
+
+class InvalidSignatures(BlockError):
+    pass
+
+
+class StateRootMismatch(BlockError):
+    pass
+
+
+class RepeatProposal(BlockError):
+    pass
+
+
+class PayloadInvalid(BlockError):
+    pass
+
+
+class AttestationError(ValueError):
+    pass
+
+
+class PriorAttestationKnown(AttestationError):
+    pass
+
+
+class AttestationSlotOutOfWindow(AttestationError):
+    pass
+
+
+class AttestationSignatureInvalid(AttestationError):
+    pass
+
+
+class UnknownHeadBlock(AttestationError):
+    pass

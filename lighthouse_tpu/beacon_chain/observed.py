@@ -1,0 +1,63 @@
+"""Anti-spam observation caches
+(``/root/reference/beacon_node/beacon_chain/src/observed_{attesters,
+block_producers}.rs``): bounded per-epoch/per-slot bitsets remembering who
+we have already seen, so gossip floods cannot re-enter the pipelines."""
+
+from __future__ import annotations
+
+from typing import Dict, Set, Tuple
+
+import numpy as np
+
+
+class ObservedAttesters:
+    """Per-(epoch, validator) seen-bits, pruned by epoch horizon
+    (`observed_attesters.rs` EpochBitfield)."""
+
+    def __init__(self, horizon_epochs: int = 2):
+        self.horizon = horizon_epochs
+        self._by_epoch: Dict[int, Set[int]] = {}
+
+    def observe(self, epoch: int, validator_index: int) -> bool:
+        """Returns True if NEW (and records it); False if already seen."""
+        seen = self._by_epoch.setdefault(epoch, set())
+        if validator_index in seen:
+            return False
+        seen.add(validator_index)
+        return True
+
+    def prune(self, current_epoch: int) -> None:
+        for e in [e for e in self._by_epoch
+                  if e + self.horizon < current_epoch]:
+            del self._by_epoch[e]
+
+
+class ObservedAggregators(ObservedAttesters):
+    """Same shape, keyed per (epoch, aggregator)."""
+
+
+class ObservedBlockProducers:
+    """Per-slot proposer dedup (`observed_block_producers.rs`)."""
+
+    def __init__(self, horizon_slots: int = 64):
+        self.horizon = horizon_slots
+        self._by_slot: Dict[int, Set[int]] = {}
+
+    def observe(self, slot: int, proposer_index: int) -> bool:
+        seen = self._by_slot.setdefault(slot, set())
+        if proposer_index in seen:
+            return False
+        seen.add(proposer_index)
+        return True
+
+    def has_been_observed(self, slot: int, proposer_index: int) -> bool:
+        """Peek without recording — the gossip pipeline checks early but
+        only records AFTER the proposal signature verifies, so unsigned
+        junk cannot censor an honest proposer
+        (`observed_block_producers.rs` proposer_has_been_observed vs
+        observe_proposer two-phase)."""
+        return proposer_index in self._by_slot.get(slot, set())
+
+    def prune(self, current_slot: int) -> None:
+        for s in [s for s in self._by_slot if s + self.horizon < current_slot]:
+            del self._by_slot[s]

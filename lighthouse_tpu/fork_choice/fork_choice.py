@@ -13,13 +13,10 @@ registry columns.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
-
 import numpy as np
 
 from .proto_array import (
     EXEC_IRRELEVANT,
-    EXEC_OPTIMISTIC,
     ProtoArrayError,
     ProtoArrayForkChoice,
     ZERO_ROOT,
@@ -148,9 +145,12 @@ class ForkChoice:
         keep = []
         for q in self.queued:
             if q.slot < self.current_slot:
-                for i in q.indices:
-                    self.proto.process_attestation(
-                        int(i), q.block_root, q.target_epoch)
+                try:
+                    for i in q.indices:
+                        self.proto.process_attestation(
+                            int(i), q.block_root, q.target_epoch)
+                except ProtoArrayError:
+                    pass  # block pruned between queue and drain: stale vote
             else:
                 keep.append(q)
         self.queued = keep
@@ -160,9 +160,10 @@ class ForkChoice:
     def get_head(self) -> bytes:
         """`fork_choice.rs:528` → `proto_array.find_head`."""
         self._drain_queued()
-        epoch = self.justified_checkpoint[0]
-        balances = _active_balances(self.justified_state, max(
-            epoch, self.current_slot // self.preset.SLOTS_PER_EPOCH))
+        # Justified balances: active validators AT the justified epoch,
+        # from the justified state (`JustifiedBalances::from_justified_state`).
+        balances = _active_balances(self.justified_state,
+                                    self.justified_checkpoint[0])
         deltas = self.proto.compute_deltas(balances)
         boost_score = 0
         if self.proposer_boost_root != ZERO_ROOT:

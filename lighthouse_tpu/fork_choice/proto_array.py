@@ -160,9 +160,11 @@ class ProtoArrayForkChoice:
                        old_b[cur_mask].astype(np.int64))
         nxt_mask = v.next >= 0
         np.add.at(deltas, v.next[nxt_mask], new_b[nxt_mask].astype(np.int64))
-        # Votes move: current ← next.
+        # Votes move: current ← next.  Persist the EQUIVOCATION-ZEROED
+        # balances: an equivocator's weight was removed this round and must
+        # not be re-subtracted on the next call.
         v.current = v.next.copy()
-        self.old_balances = new_balances.copy()
+        self.old_balances = new_b.copy()
         return deltas
 
     def apply_score_changes(self, deltas: np.ndarray,
@@ -320,12 +322,14 @@ class ProtoArrayForkChoice:
         start = self.indices.get(root)
         if start is None:
             return
+        # Mark only; weights stay intact so the next apply_score_changes
+        # can compute d = -weight and propagate the REMOVAL to ancestors —
+        # pre-zeroing here would leave phantom subtree weight above the
+        # invalidated block (`proto_array.rs:209-216` relies on the same).
         invalid = {start}
         self.nodes[start].execution_status = EXEC_INVALID
-        self.nodes[start].weight = 0
         for i in range(start + 1, len(self.nodes)):
             node = self.nodes[i]
             if node.parent in invalid:
                 node.execution_status = EXEC_INVALID
-                node.weight = 0
                 invalid.add(i)

@@ -1,0 +1,202 @@
+"""Operation pool: pending operations for block production.
+
+Counterpart of ``beacon_node/operation_pool``
+(``/root/reference/beacon_node/operation_pool/src/lib.rs``): attestations
+stored compactly per ``AttestationData`` with aggregation-bit merging (the
+``attestation_storage.rs`` split/compact idea), block packing by greedy
+weighted max-coverage (``max_cover.rs``, ``attestation.rs`` AttMaxCover),
+plus slashings/exits/BLS-change pools with per-validator de-duplication
+(``lib.rs:366`` ``get_slashings_and_exits``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Hashable, List, Optional, Tuple
+
+import numpy as np
+
+from .max_cover import maximum_cover
+
+__all__ = ["OperationPool", "AttMaxCover", "maximum_cover"]
+
+
+class AttMaxCover:
+    """Attestation candidate weighted by effective balances of the NEW
+    attesters it would add (`attestation.rs` AttMaxCover; rewards are
+    balance-proportional, so balance weight orders candidates the same
+    way as the reference's base-reward weight)."""
+
+    def __init__(self, att, fresh_indices: np.ndarray,
+                 balances: np.ndarray):
+        self.att = att
+        self._cover: Dict[int, int] = {
+            int(i): int(balances[int(i)]) for i in fresh_indices}
+
+    def covering_set(self) -> Dict[int, int]:
+        return self._cover
+
+    def update_covering_set(self, covered: Dict[int, int]) -> None:
+        for k in covered:
+            self._cover.pop(k, None)
+
+
+@dataclass
+class _StoredAttestation:
+    data: object              # AttestationData
+    bits: np.ndarray          # bool aggregation bits (committee-sized)
+    signature: bytes          # aggregate signature bytes
+    committee: np.ndarray     # validator indices for (slot, index)
+
+
+class OperationPool:
+    """Pending ops, keyed for de-duplication, packed on demand."""
+
+    def __init__(self, preset, spec):
+        self.preset = preset
+        self.spec = spec
+        # (data_root, committee_key) → list of compatible aggregates.
+        self.attestations: Dict[bytes, List[_StoredAttestation]] = {}
+        self.proposer_slashings: Dict[int, object] = {}
+        self.attester_slashings: List[object] = []
+        self.voluntary_exits: Dict[int, object] = {}
+        self.bls_changes: Dict[int, object] = {}
+        self.sync_contributions: Dict[Tuple[int, bytes], object] = {}
+
+    # -- attestations --------------------------------------------------------
+
+    def insert_attestation(self, att, committee: np.ndarray) -> None:
+        """Merge into an existing aggregate when disjoint, else keep both
+        (`lib.rs:198` insert_attestation + naive aggregation)."""
+        key = att.data.tree_hash_root()
+        bits = np.asarray(att.aggregation_bits, dtype=bool)
+        entry = self.attestations.setdefault(key, [])
+        for stored in entry:
+            if not (stored.bits & bits).any():
+                stored.bits = stored.bits | bits
+                from ..crypto import bls
+                sig_a = bls.Signature.deserialize(stored.signature)
+                sig_b = bls.Signature.deserialize(bytes(att.signature))
+                stored.signature = bls.aggregate_signatures(
+                    [sig_a, sig_b]).serialize()
+                return
+        entry.append(_StoredAttestation(
+            data=att.data, bits=bits.copy(),
+            signature=bytes(att.signature),
+            committee=np.asarray(committee)))
+
+    def num_attestations(self) -> int:
+        return sum(len(v) for v in self.attestations.values())
+
+    def get_attestations(self, state, T) -> List:
+        """Pack ≤ MAX_ATTESTATIONS by greedy max-cover over fresh attester
+        balances (`lib.rs:248` get_attestations)."""
+        slot = int(state.slot)
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        balances = state.validators.col("effective_balance")
+        # Validators already credited this epoch cover nothing new.
+        seen: set[int] = set()
+        part = np.asarray(state.current_epoch_participation)
+        if part.size:
+            seen.update(np.nonzero(part)[0].tolist())
+        candidates = []
+        for entry in self.attestations.values():
+            for stored in entry:
+                att_slot = int(stored.data.slot)
+                att_epoch = att_slot // self.preset.SLOTS_PER_EPOCH
+                if att_slot + self.preset.MIN_ATTESTATION_INCLUSION_DELAY > slot:
+                    continue
+                if att_epoch not in (epoch, epoch - 1):
+                    continue
+                idx = stored.committee[stored.bits[:len(stored.committee)]]
+                fresh = np.asarray([i for i in idx if int(i) not in seen],
+                                   dtype=np.int64)
+                if fresh.size == 0:
+                    continue
+                candidates.append((stored, AttMaxCover(stored, fresh,
+                                                       balances)))
+        covers = [c for _, c in candidates]
+        chosen = maximum_cover(covers, self.preset.MAX_ATTESTATIONS)
+        return [self._to_attestation(c.att, T) for c in chosen]
+
+    def _to_attestation(self, stored: _StoredAttestation, T):
+        return T.Attestation(
+            aggregation_bits=stored.bits[:len(stored.committee)].tolist(),
+            data=stored.data,
+            signature=stored.signature)
+
+    # -- slashings / exits / changes ----------------------------------------
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        self.proposer_slashings[
+            int(slashing.signed_header_1.message.proposer_index)] = slashing
+
+    def insert_attester_slashing(self, slashing) -> None:
+        self.attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, exit_) -> None:
+        self.voluntary_exits[int(exit_.message.validator_index)] = exit_
+
+    def insert_bls_to_execution_change(self, change) -> None:
+        self.bls_changes[int(change.message.validator_index)] = change
+
+    def get_slashings_and_exits(self, state) -> Tuple[List, List, List]:
+        """Filter against the state: not-yet-slashed / still-exitable
+        (`lib.rs:366`)."""
+        reg = state.validators
+        slashed = reg.col("slashed")
+        exiting = reg.col("exit_epoch")
+        from ..types.chain_spec import FAR_FUTURE_EPOCH
+
+        proposer = [
+            s for i, s in self.proposer_slashings.items()
+            if i < len(reg) and not slashed[i]
+        ][:self.preset.MAX_PROPOSER_SLASHINGS]
+
+        attester, covered = [], set()
+        for s in self.attester_slashings:
+            a = set(int(i) for i in s.attestation_1.attesting_indices)
+            b = set(int(i) for i in s.attestation_2.attesting_indices)
+            both = {i for i in a & b
+                    if i < len(reg) and not slashed[i] and i not in covered}
+            if both:
+                covered |= both
+                attester.append(s)
+            if len(attester) >= self.preset.MAX_ATTESTER_SLASHINGS:
+                break
+
+        exits = [
+            e for i, e in self.voluntary_exits.items()
+            if i < len(reg) and not slashed[i]
+            and int(exiting[i]) == FAR_FUTURE_EPOCH
+        ][:self.preset.MAX_VOLUNTARY_EXITS]
+        return proposer, attester, exits
+
+    def get_bls_to_execution_changes(self, state) -> List:
+        creds = state.validators.col("withdrawal_credentials")
+        out = []
+        for i, change in self.bls_changes.items():
+            if i < creds.shape[0] and creds[i][0] == 0x00:
+                out.append(change)
+            if len(out) >= self.preset.MAX_BLS_TO_EXECUTION_CHANGES:
+                break
+        return out
+
+    # -- maintenance ---------------------------------------------------------
+
+    def prune(self, state) -> None:
+        """Drop everything no longer includable (`lib.rs` prune_all)."""
+        epoch = int(state.slot) // self.preset.SLOTS_PER_EPOCH
+        self.attestations = {
+            k: [s for s in v
+                if int(s.data.slot) // self.preset.SLOTS_PER_EPOCH
+                >= epoch - 1]
+            for k, v in self.attestations.items()}
+        self.attestations = {k: v for k, v in self.attestations.items() if v}
+        slashed = state.validators.col("slashed")
+        self.proposer_slashings = {
+            i: s for i, s in self.proposer_slashings.items()
+            if i < slashed.shape[0] and not slashed[i]}
+        self.voluntary_exits = {
+            i: e for i, e in self.voluntary_exits.items()
+            if i < slashed.shape[0] and not slashed[i]}

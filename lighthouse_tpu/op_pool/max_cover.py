@@ -1,0 +1,45 @@
+"""Greedy weighted maximum-coverage — the block-packing core
+(``/root/reference/beacon_node/operation_pool/src/max_cover.rs:11-53``).
+
+The classic (1 − 1/e) greedy: repeatedly take the candidate with the
+highest remaining weight, then strike its covered elements out of every
+other candidate.  Candidates expose their covering dict so the update is
+one dict-difference per round, exactly the reference's
+``update_covering_set`` contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Generic, Hashable, List, Protocol, TypeVar
+
+T = TypeVar("T")
+
+
+class MaxCoverItem(Protocol):
+    """`MaxCover` trait: an object with a covering-set weight map."""
+
+    def covering_set(self) -> Dict[Hashable, int]:
+        ...
+
+    def update_covering_set(self, covered: Dict[Hashable, int]) -> None:
+        ...
+
+
+def maximum_cover(items: List, limit: int) -> List:
+    """Pick ≤ ``limit`` items maximising total covered weight
+    (`max_cover.rs` ``maximum_cover()``)."""
+    candidates = [it for it in items if sum(it.covering_set().values()) > 0]
+    chosen: List = []
+    while candidates and len(chosen) < limit:
+        best = max(candidates,
+                   key=lambda it: sum(it.covering_set().values()))
+        if sum(best.covering_set().values()) == 0:
+            break
+        covered = dict(best.covering_set())
+        chosen.append(best)
+        candidates.remove(best)
+        for it in candidates:
+            it.update_covering_set(covered)
+        candidates = [it for it in candidates
+                      if sum(it.covering_set().values()) > 0]
+    return chosen

@@ -18,10 +18,15 @@ class SlotProcessingError(ValueError):
     pass
 
 
-def process_slot(state, preset) -> bytes:
+def process_slot(state, preset, known_root: bytes | None = None) -> bytes:
     """One ``process_slot``: record state root, backfill header state root,
-    record block root.  Returns the cached state root."""
-    state_root = state.tree_hash_root()
+    record block root.  Returns the cached state root.
+
+    ``known_root`` short-circuits the state-root computation when the
+    caller already has it (store replay via ``BlockReplayer`` —
+    ``block_replayer.rs`` ``state_root_iter``)."""
+    state_root = known_root if known_root is not None \
+        else state.tree_hash_root()
     state.state_roots.set(state.slot % preset.SLOTS_PER_HISTORICAL_ROOT,
                           state_root)
     if state.latest_block_header.state_root == b"\x00" * 32:
@@ -32,16 +37,21 @@ def process_slot(state, preset) -> bytes:
     return state_root
 
 
-def process_slots(state, target_slot: int, preset, spec, T):
+def process_slots(state, target_slot: int, preset, spec, T,
+                  state_root_fn=None):
     """Advance ``state`` to ``target_slot`` (epoch processing + fork
     upgrades on the way).  Returns the (possibly upgraded) state — upgrades
     change the state's class, mirroring ``per_slot_processing``'s
-    ``Option<EpochProcessingSummary>`` + upgrade handling."""
+    ``Option<EpochProcessingSummary>`` + upgrade handling.
+
+    ``state_root_fn(slot) -> bytes | None`` supplies known state roots to
+    skip hashing during replay."""
     if target_slot < state.slot:
         raise SlotProcessingError(
             f"cannot rewind state from {state.slot} to {target_slot}")
     while state.slot < target_slot:
-        process_slot(state, preset)
+        known = state_root_fn(int(state.slot)) if state_root_fn else None
+        process_slot(state, preset, known_root=known)
         if (state.slot + 1) % preset.SLOTS_PER_EPOCH == 0:
             fork = spec.fork_name_at_epoch(
                 state.slot // preset.SLOTS_PER_EPOCH)

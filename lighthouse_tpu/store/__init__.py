@@ -1,0 +1,17 @@
+"""Persistent storage: KV abstraction + hot/cold beacon DB.
+
+Counterpart of ``beacon_node/store``
+(``/root/reference/beacon_node/store/src/``): a column-oriented
+``KeyValueStore`` seam with in-memory and SQLite backends (the reference
+uses LevelDB via FFI — SQLite is this build's embedded native engine), and
+``HotColdDB`` with epoch-boundary full states + ``HotStateSummary`` replay
+between them.
+"""
+
+from .kv import DBColumn, KeyValueStore, MemoryStore, SqliteStore
+from .hot_cold import HotColdDB, HotStateSummary, StoreError
+
+__all__ = [
+    "DBColumn", "KeyValueStore", "MemoryStore", "SqliteStore",
+    "HotColdDB", "HotStateSummary", "StoreError",
+]

@@ -1,0 +1,226 @@
+"""Two-tier beacon database — ``HotColdDB``
+(``/root/reference/beacon_node/store/src/hot_cold_store.rs:48``).
+
+Hot tier: every block; full states at epoch boundaries; a
+``HotStateSummary`` {slot, latest_block_root, epoch_boundary_state_root}
+for every other state, reconstructed by replaying blocks from the boundary
+state (``hot_cold_store.rs:587`` + ``state_processing``'s BlockReplayer).
+
+Cold tier (freezer): on finalization, blocks and periodic restore-point
+states (every ``slots_per_restore_point``) migrate to cold columns and the
+hot tier is pruned up to the split slot (``migrate.rs`` role, here a
+synchronous call).  States between restore points replay from the previous
+restore point.
+
+All state/block values are SSZ, tagged with a 1-byte fork id so the right
+per-fork container class decodes them.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Tuple
+
+from ..types.chain_spec import ForkName
+from ..state_transition.block_replayer import BlockReplayer
+from .kv import DBColumn, KeyValueStore, MemoryStore
+
+_FORK_IDS = {f: i for i, f in enumerate(ForkName)}
+_FORK_BY_ID = {i: f for f, i in _FORK_IDS.items()}
+
+SCHEMA_VERSION = 1
+
+
+class StoreError(ValueError):
+    pass
+
+
+@dataclass
+class HotStateSummary:
+    """`HotStateSummary` (`hot_cold_store.rs` StoreItem)."""
+    slot: int
+    latest_block_root: bytes
+    epoch_boundary_state_root: bytes
+
+    def encode(self) -> bytes:
+        return struct.pack("<Q", self.slot) + self.latest_block_root \
+            + self.epoch_boundary_state_root
+
+    @classmethod
+    def decode(cls, data: bytes) -> "HotStateSummary":
+        if len(data) != 8 + 32 + 32:
+            raise StoreError("bad hot state summary encoding")
+        return cls(struct.unpack("<Q", data[:8])[0], data[8:40], data[40:72])
+
+
+class HotColdDB:
+    """The chain's persistence root object."""
+
+    def __init__(self, kv: KeyValueStore, preset, spec, T,
+                 slots_per_restore_point: int | None = None):
+        self.kv = kv
+        self.preset = preset
+        self.spec = spec
+        self.T = T
+        self.sprp = slots_per_restore_point or (
+            2 * preset.SLOTS_PER_EPOCH)
+        self.split_slot = 0
+        self._load_meta()
+
+    @classmethod
+    def memory(cls, preset, spec, T) -> "HotColdDB":
+        return cls(MemoryStore(), preset, spec, T)
+
+    # -- metadata ------------------------------------------------------------
+
+    def _load_meta(self) -> None:
+        v = self.kv.get(DBColumn.BeaconMeta, b"schema")
+        if v is None:
+            self.kv.put(DBColumn.BeaconMeta, b"schema",
+                        struct.pack("<Q", SCHEMA_VERSION))
+        elif struct.unpack("<Q", v)[0] != SCHEMA_VERSION:
+            raise StoreError(
+                f"schema version {struct.unpack('<Q', v)[0]} needs migration")
+        sp = self.kv.get(DBColumn.BeaconMeta, b"split")
+        if sp is not None:
+            self.split_slot = struct.unpack("<Q", sp)[0]
+
+    def _store_meta(self) -> None:
+        self.kv.put(DBColumn.BeaconMeta, b"split",
+                    struct.pack("<Q", self.split_slot))
+
+    # -- blocks --------------------------------------------------------------
+
+    def put_block(self, block_root: bytes, signed_block) -> None:
+        fork = self.T.fork_of_block(signed_block)
+        self.kv.put(DBColumn.BeaconBlock, block_root,
+                    bytes([_FORK_IDS[fork]]) + signed_block.encode())
+
+    def get_block(self, block_root: bytes):
+        for col in (DBColumn.BeaconBlock, DBColumn.ColdBlock):
+            data = self.kv.get(col, block_root)
+            if data is not None:
+                fork = _FORK_BY_ID[data[0]]
+                return self.T.signed_block_cls(fork).deserialize(data[1:])
+        return None
+
+    # -- states --------------------------------------------------------------
+
+    def put_state(self, state_root: bytes, state,
+                  latest_block_root: bytes) -> None:
+        """Full state at epoch boundaries, summary otherwise
+        (`store_hot_state`, `hot_cold_store.rs:560-610`)."""
+        slot = int(state.slot)
+        if slot % self.preset.SLOTS_PER_EPOCH == 0:
+            self._put_full_state(DBColumn.BeaconState, state_root, state)
+        else:
+            boundary_slot = (slot // self.preset.SLOTS_PER_EPOCH
+                             * self.preset.SLOTS_PER_EPOCH)
+            boundary_root = bytes(state.state_roots.get(
+                boundary_slot % self.preset.SLOTS_PER_HISTORICAL_ROOT))
+            summary = HotStateSummary(slot, latest_block_root, boundary_root)
+            self.kv.put(DBColumn.BeaconStateSummary, state_root,
+                        summary.encode())
+
+    def _put_full_state(self, col: DBColumn, state_root: bytes, state) -> None:
+        fork = self.T.fork_of_state(state)
+        self.kv.put(col, state_root, bytes([_FORK_IDS[fork]]) + state.encode())
+
+    def _get_full_state(self, col: DBColumn, state_root: bytes):
+        data = self.kv.get(col, state_root)
+        if data is None:
+            return None
+        fork = _FORK_BY_ID[data[0]]
+        return self.T.state_cls(fork).deserialize(data[1:])
+
+    def get_state(self, state_root: bytes):
+        """Full state, summary-replay, or restore-point replay
+        (`load_hot_state` / `load_cold_state`)."""
+        state = self._get_full_state(DBColumn.BeaconState, state_root)
+        if state is not None:
+            return state
+        summary_data = self.kv.get(DBColumn.BeaconStateSummary, state_root)
+        if summary_data is not None:
+            return self._replay_from_summary(
+                HotStateSummary.decode(summary_data))
+        state = self._get_full_state(DBColumn.ColdState, state_root)
+        if state is not None:
+            return state
+        return None
+
+    def _block_chain_to(self, latest_block_root: bytes,
+                        after_slot: int) -> List:
+        """Blocks (ascending) strictly after ``after_slot`` ending at
+        ``latest_block_root``, following parent pointers."""
+        blocks = []
+        root = latest_block_root
+        while True:
+            block = self.get_block(root)
+            if block is None or int(block.message.slot) <= after_slot:
+                break
+            blocks.append(block)
+            root = bytes(block.message.parent_root)
+        blocks.reverse()
+        return blocks
+
+    def _replay_from_summary(self, summary: HotStateSummary):
+        base = self._get_full_state(DBColumn.BeaconState,
+                                    summary.epoch_boundary_state_root)
+        if base is None:
+            raise StoreError("missing epoch boundary state for summary")
+        blocks = self._block_chain_to(summary.latest_block_root,
+                                      int(base.slot))
+        replayer = BlockReplayer(base, self.preset, self.spec, self.T)
+        return replayer.apply_blocks(blocks, target_slot=summary.slot)
+
+    # -- finalization migration (hot → cold) ---------------------------------
+
+    def migrate_to_cold(self, finalized_slot: int,
+                        finalized_block_root: bytes) -> None:
+        """Move finalized blocks to the freezer, keep restore-point states,
+        prune hot summaries/states below the split
+        (`migrate.rs` + `hot_cold_store.rs` migrate_database)."""
+        if finalized_slot <= self.split_slot:
+            return
+        # Blocks along the finalized chain → cold.
+        chain = self._block_chain_to(finalized_block_root, -1)
+        ops = []
+        for signed in chain:
+            if int(signed.message.slot) >= finalized_slot:
+                continue
+            root = signed.message.tree_hash_root()
+            data = self.kv.get(DBColumn.BeaconBlock, root)
+            if data is not None:
+                ops.append(("put", DBColumn.ColdBlock, root, data))
+                ops.append(("delete", DBColumn.BeaconBlock, root, None))
+        # Hot states below the split: keep restore points, drop the rest.
+        for state_root, data in list(self.kv.iter_column(DBColumn.BeaconState)):
+            state_slot = self._peek_state_slot(data)
+            if state_slot < finalized_slot:
+                if state_slot % self.sprp == 0:
+                    ops.append(("put", DBColumn.ColdState, state_root, data))
+                    ops.append(("put", DBColumn.BeaconRestorePoint,
+                                struct.pack("<Q", state_slot), state_root))
+                ops.append(("delete", DBColumn.BeaconState, state_root, None))
+        for state_root, data in list(
+                self.kv.iter_column(DBColumn.BeaconStateSummary)):
+            if HotStateSummary.decode(data).slot < finalized_slot:
+                ops.append(("delete", DBColumn.BeaconStateSummary,
+                            state_root, None))
+        self.kv.do_atomically(ops)
+        self.split_slot = finalized_slot
+        self._store_meta()
+
+    def _peek_state_slot(self, data: bytes) -> int:
+        # BeaconState SSZ layout: genesis_time (8) + genesis_validators_root
+        # (32) + slot (8) — fixed offsets for every fork.
+        return struct.unpack("<Q", data[1 + 40:1 + 48])[0]
+
+    # -- persisted singletons (fork choice, op pool, chain) ------------------
+
+    def put_item(self, column: DBColumn, key: bytes, value: bytes) -> None:
+        self.kv.put(column, key, value)
+
+    def get_item(self, column: DBColumn, key: bytes) -> Optional[bytes]:
+        return self.kv.get(column, key)

@@ -1,0 +1,157 @@
+"""Column-oriented key-value store seam.
+
+``KeyValueStore``/``ItemStore`` traits of the reference
+(``/root/reference/beacon_node/store/src/lib.rs:169-210`` DBColumn,
+``leveldb_store.rs``, ``memory_store.rs``), with two backends:
+
+- :class:`MemoryStore` — dict-backed, for tests and ephemeral harnesses
+  (the reference's ``MemoryStore``);
+- :class:`SqliteStore` — embedded on-disk engine (the reference links
+  LevelDB/C++; SQLite is the embedded native store available here), with
+  WAL journaling and batched atomic writes.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from enum import Enum
+from typing import Iterable, Optional, Sequence, Tuple
+
+
+class DBColumn(str, Enum):
+    """`DBColumn` (`store/src/lib.rs:169`) — the subset in use."""
+    BeaconMeta = "bma"
+    BeaconBlock = "blk"
+    BeaconState = "ste"
+    BeaconStateSummary = "bss"
+    BeaconChain = "bch"
+    OpPool = "opo"
+    ForkChoice = "frk"
+    PubkeyCache = "pkc"
+    BeaconRestorePoint = "brp"
+    ColdBlock = "cbk"
+    ColdState = "cst"
+
+
+class KeyValueStore:
+    """Abstract column KV API (get/put/delete/iter + atomic batches)."""
+
+    def get(self, column: DBColumn, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, column: DBColumn, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, column: DBColumn, key: bytes) -> None:
+        raise NotImplementedError
+
+    def do_atomically(self, ops: Sequence[Tuple[str, DBColumn, bytes,
+                                                Optional[bytes]]]) -> None:
+        """ops: ("put", col, key, value) | ("delete", col, key, None)."""
+        raise NotImplementedError
+
+    def iter_column(self, column: DBColumn) -> Iterable[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryStore(KeyValueStore):
+    def __init__(self):
+        self._data: dict[tuple[str, bytes], bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, column, key):
+        return self._data.get((column.value, bytes(key)))
+
+    def put(self, column, key, value):
+        with self._lock:
+            self._data[(column.value, bytes(key))] = bytes(value)
+
+    def delete(self, column, key):
+        with self._lock:
+            self._data.pop((column.value, bytes(key)), None)
+
+    def do_atomically(self, ops):
+        with self._lock:
+            for op, col, key, value in ops:
+                if op == "put":
+                    self._data[(col.value, bytes(key))] = bytes(value)
+                elif op == "delete":
+                    self._data.pop((col.value, bytes(key)), None)
+                else:
+                    raise ValueError(op)
+
+    def iter_column(self, column):
+        with self._lock:
+            items = [(k[1], v) for k, v in self._data.items()
+                     if k[0] == column.value]
+        return iter(items)
+
+
+class SqliteStore(KeyValueStore):
+    """One table per database: (column, key) → value, WAL mode."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv ("
+                "col TEXT NOT NULL, key BLOB NOT NULL, value BLOB NOT NULL, "
+                "PRIMARY KEY (col, key)) WITHOUT ROWID")
+            self._conn.commit()
+
+    def get(self, column, key):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM kv WHERE col=? AND key=?",
+                (column.value, bytes(key))).fetchone()
+        return None if row is None else row[0]
+
+    def put(self, column, key, value):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (col, key, value) VALUES (?,?,?)",
+                (column.value, bytes(key), bytes(value)))
+            self._conn.commit()
+
+    def delete(self, column, key):
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE col=? AND key=?",
+                               (column.value, bytes(key)))
+            self._conn.commit()
+
+    def do_atomically(self, ops):
+        with self._lock:
+            try:
+                for op, col, key, value in ops:
+                    if op == "put":
+                        self._conn.execute(
+                            "INSERT OR REPLACE INTO kv (col, key, value) "
+                            "VALUES (?,?,?)", (col.value, bytes(key),
+                                               bytes(value)))
+                    elif op == "delete":
+                        self._conn.execute(
+                            "DELETE FROM kv WHERE col=? AND key=?",
+                            (col.value, bytes(key)))
+                    else:
+                        raise ValueError(op)
+                self._conn.commit()
+            except Exception:
+                self._conn.rollback()
+                raise
+
+    def iter_column(self, column):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM kv WHERE col=?",
+                (column.value,)).fetchall()
+        return iter(rows)
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

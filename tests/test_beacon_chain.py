@@ -1,0 +1,165 @@
+"""BeaconChain runtime: pipeline stages, rejections, head tracking,
+attestation batches, production from the pool.
+
+Mirrors `beacon_chain/tests/block_verification.rs` /
+`attestation_verification.rs` scenarios on the in-process harness.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.beacon_chain import (
+    BeaconChain,
+    BlockIsAlreadyKnown,
+    FutureSlot,
+    IncorrectProposer,
+    InvalidSignatures,
+    ParentUnknown,
+    ProposalSignatureInvalid,
+    StateRootMismatch,
+)
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+def make_chain(n_validators=16):
+    h = StateHarness(n_validators=n_validators, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    genesis_root = hdr.tree_hash_root()
+    db = HotColdDB.memory(h.preset, h.spec, h.T)
+    chain = BeaconChain(store=db, genesis_state=h.state.copy(),
+                        genesis_block_root=genesis_root,
+                        preset=h.preset, spec=h.spec, T=h.T)
+    return h, chain
+
+
+def test_chain_imports_harness_blocks_and_tracks_head():
+    h, chain = make_chain()
+    for _ in range(4):
+        signed = h.build_block()
+        h.apply_block(signed)
+        chain.per_slot_task(int(signed.message.slot))
+        root = chain.process_block(signed, is_timely=True)
+        assert chain.head.root == root
+        assert chain.head.slot == int(signed.message.slot)
+        # Post-state persisted and reloadable.
+        assert chain.store.get_block(root) is not None
+    # Head state equals the harness state.
+    assert chain.head.state.tree_hash_root() == h.state.tree_hash_root()
+
+
+def test_rejections_at_each_stage():
+    # Fresh chain per case: once a proposer's (validly signed) block is
+    # observed at a slot, a second distinct block from the same proposer is
+    # equivocation (RepeatProposal) — faithful to the reference's
+    # observed_block_producers semantics, but it would shadow later cases.
+    h, chain = make_chain()
+    signed = h.build_block()
+    with pytest.raises(FutureSlot):
+        chain.process_block(signed)
+
+    h, chain = make_chain()
+    bad = h.build_block()
+    bad.message.parent_root = b"\x42" * 32
+    chain.per_slot_task(int(bad.message.slot))
+    with pytest.raises(ParentUnknown):
+        chain.process_block(bad)
+
+    h, chain = make_chain()
+    bad2 = h.build_block()
+    bad2.message.proposer_index = (int(bad2.message.proposer_index) + 1) % 16
+    chain.per_slot_task(int(bad2.message.slot))
+    with pytest.raises(IncorrectProposer):
+        chain.process_block(bad2)
+
+    h, chain = make_chain()
+    bad3 = h.build_block()
+    bad3.message.state_root = b"\x13" * 32
+    chain.per_slot_task(int(bad3.message.slot))
+    with pytest.raises(StateRootMismatch):
+        chain.process_block(bad3)
+
+    h, chain = make_chain()
+    signed = h.build_block()
+    chain.per_slot_task(int(signed.message.slot))
+    chain.process_block(signed)
+    h.apply_block(signed)
+    with pytest.raises(BlockIsAlreadyKnown):
+        chain.process_block(signed)
+
+    # Same proposer, different block at the same slot → equivocation.
+    h, chain = make_chain()
+    signed = h.build_block()
+    other = h.build_block(graffiti=b"equivocation".ljust(32, b"\x00"))
+    chain.per_slot_task(int(signed.message.slot))
+    chain.process_block(signed)
+    from lighthouse_tpu.beacon_chain import RepeatProposal
+    with pytest.raises(RepeatProposal):
+        chain.process_block(other)
+
+
+def test_proposal_signature_checked_with_real_crypto():
+    B.set_backend("python")
+    h, chain = make_chain(n_validators=8)
+    signed = h.build_block()
+    chain.per_slot_task(int(signed.message.slot))
+    # Tamper the proposal signature: flip to a valid-encoding wrong sig.
+    from lighthouse_tpu.crypto import curve as C
+    wrong = C.g2_compress(C.g2_mul(C.G2_GEN, 12345))
+    good_sig = bytes(signed.signature)
+    signed.signature = wrong
+    with pytest.raises(ProposalSignatureInvalid):
+        chain.process_block(signed)
+    signed.signature = good_sig
+    root = chain.process_block(signed)
+    assert chain.head.root == root
+
+
+def test_attestation_batch_feeds_pool_and_fork_choice():
+    h, chain = make_chain()
+    for _ in range(2):
+        signed = h.build_block()
+        h.apply_block(signed)
+        chain.per_slot_task(int(signed.message.slot))
+        chain.process_block(signed)
+    atts = h.attestations_for_slot(h.state, int(h.state.slot) - 1)
+    chain.per_slot_task(int(h.state.slot) + 1)
+    results = chain.process_attestation_batch(atts)
+    assert all(err is None for _, err in results)
+    assert chain.op_pool.num_attestations() > 0
+    # Re-submitting the same batch dedups via observed attesters.
+    results2 = chain.process_attestation_batch(atts)
+    assert all(v is None for v, _ in results2)
+
+
+def test_produce_block_packs_pool_operations():
+    h, chain = make_chain()
+    for _ in range(2):
+        signed = h.build_block()
+        h.apply_block(signed)
+        chain.per_slot_task(int(signed.message.slot))
+        chain.process_block(signed)
+    atts = h.attestations_for_slot(h.state, int(h.state.slot) - 1)
+    chain.per_slot_task(int(h.state.slot) + 1)
+    chain.process_attestation_batch(atts)
+    chain.op_pool.insert_voluntary_exit(h.make_exit(h.state, 7))
+    produce_state = chain.head.state.copy()
+    # With 16 validators every attester is already credited this epoch;
+    # reset participation so the pool's aggregates have fresh coverage.
+    produce_state.current_epoch_participation[:] = 0
+    parts = chain.produce_block_on_state(
+        produce_state, int(h.state.slot) + 1,
+        randao_reveal=b"\x00" * 96)
+    assert parts["proposer_index"] == int(parts["proposer_index"])
+    assert len(parts["voluntary_exits"]) == 1
+    assert len(parts["attestations"]) > 0

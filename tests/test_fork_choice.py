@@ -110,6 +110,9 @@ def test_equivocation_removes_weight():
     assert head_of(pa, [100, 10]) == root(1)
     pa.process_equivocation(0)
     assert head_of(pa, [100, 10]) == root(2)
+    # Repeated head computations must not re-subtract the removed weight.
+    assert head_of(pa, [100, 10]) == root(2)
+    assert head_of(pa, [100, 10]) == root(2)
 
 
 def test_invalid_payload_zeroes_subtree():
@@ -119,6 +122,11 @@ def test_invalid_payload_zeroes_subtree():
     pa.process_attestation(0, root(3), 1)
     assert head_of(pa, [50]) == root(3)
     pa.on_invalid_execution_payload(root(1))
+    assert head_of(pa, [50]) == root(2)
+    # The invalidated subtree's weight is REMOVED from ancestors, not
+    # frozen in place: the genesis node carries no phantom weight.
+    assert pa.nodes[pa.indices[root(1)]].weight == 0
+    assert pa.nodes[pa.indices[root(3)]].weight == 0
     assert head_of(pa, [50]) == root(2)
 
 

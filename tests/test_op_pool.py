@@ -1,0 +1,119 @@
+"""Operation pool: max-cover packing, aggregation merging, filtering.
+
+Mirrors `operation_pool` tests: greedy coverage ordering, overlap
+discounting, disjoint-aggregate merging, state-filtered slashings/exits
+(`max_cover.rs` tests, `lib.rs:248,366`).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.op_pool import OperationPool, maximum_cover
+from lighthouse_tpu.op_pool.max_cover import MaxCoverItem
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+class Item:
+    def __init__(self, cover):
+        self._c = dict(cover)
+
+    def covering_set(self):
+        return self._c
+
+    def update_covering_set(self, covered):
+        for k in covered:
+            self._c.pop(k, None)
+
+
+def test_maximum_cover_greedy_and_overlap():
+    a = Item({1: 10, 2: 10})
+    b = Item({2: 10, 3: 10, 4: 10})
+    c = Item({5: 1})
+    out = maximum_cover([a, b, c], 2)
+    # b first (30), then a covers only {1} (10) — still beats c (1).
+    assert out == [b, a]
+    # Overlap was discounted: a's残 covering set is just {1}.
+    assert a.covering_set() == {1: 10}
+
+
+def test_maximum_cover_respects_limit_and_skips_empty():
+    items = [Item({i: 1}) for i in range(5)] + [Item({})]
+    out = maximum_cover(items, 3)
+    assert len(out) == 3
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+def _pool_with_chain(n_blocks=3):
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    pool = OperationPool(h.preset, h.spec)
+    h.extend_chain(n_blocks)
+    return h, pool
+
+
+def test_insert_merges_disjoint_aggregates():
+    h, pool = _pool_with_chain()
+    atts = h.attestations_for_slot(h.state, int(h.state.slot) - 1)
+    att = atts[0]
+    committee = np.arange(len(att.aggregation_bits))
+    bits = np.asarray(att.aggregation_bits, dtype=bool)
+    half = len(bits) // 2 or 1
+    import copy
+    a1 = copy.deepcopy(att)
+    a1.aggregation_bits = (bits & (np.arange(len(bits)) < half)).tolist()
+    a2 = copy.deepcopy(att)
+    a2.aggregation_bits = (bits & (np.arange(len(bits)) >= half)).tolist()
+    pool.insert_attestation(a1, committee)
+    assert pool.num_attestations() == 1
+    pool.insert_attestation(a2, committee)
+    # Disjoint bits merged into ONE stored aggregate.
+    assert pool.num_attestations() == 1
+    stored = next(iter(pool.attestations.values()))[0]
+    assert stored.bits.sum() == bits.sum()
+
+
+def test_get_attestations_packs_fresh_cover():
+    from lighthouse_tpu.state_transition.committees import get_beacon_committee
+    h, pool = _pool_with_chain(3)
+    slot = int(h.state.slot) - 1
+    for att in h.attestations_for_slot(h.state, slot):
+        committee = get_beacon_committee(
+            h.state, int(att.data.slot), int(att.data.index), h.preset)
+        pool.insert_attestation(att, np.asarray(committee))
+    # Reset participation so the pool's attesters count as fresh (the
+    # harness blocks already credited them for this epoch).
+    h.state.current_epoch_participation[:] = 0
+    packed = pool.get_attestations(h.state, h.T)
+    assert 0 < len(packed) <= h.preset.MAX_ATTESTATIONS
+    # Packed attestations decode as real containers with live bits.
+    assert any(any(a.aggregation_bits) for a in packed)
+
+
+def test_slashings_and_exits_filtered_by_state():
+    h, pool = _pool_with_chain(2)
+    pool.insert_proposer_slashing(h.make_proposer_slashing(h.state, 3))
+    pool.insert_attester_slashing(h.make_attester_slashing(h.state, [4, 5]))
+    pool.insert_voluntary_exit(h.make_exit(h.state, 6))
+    ps, ats, exits = pool.get_slashings_and_exits(h.state)
+    assert len(ps) == 1 and len(ats) == 1 and len(exits) == 1
+    # Mark validator 3 slashed → its proposer slashing is filtered out.
+    h.state.validators.wcol("slashed")[3] = True
+    ps, ats, exits = pool.get_slashings_and_exits(h.state)
+    assert len(ps) == 0
+    pool.prune(h.state)
+    assert 3 not in pool.proposer_slashings
+
+
+def test_attester_slashing_dedup_by_covered_indices():
+    h, pool = _pool_with_chain(2)
+    pool.insert_attester_slashing(h.make_attester_slashing(h.state, [4, 5]))
+    pool.insert_attester_slashing(h.make_attester_slashing(h.state, [4, 5]))
+    _, ats, _ = pool.get_slashings_and_exits(h.state)
+    assert len(ats) == 1  # second covers no new validators
