@@ -40,9 +40,22 @@ class VerifiedAttestation:
     committee: np.ndarray
 
 
-def _cheap_checks(chain, att) -> Tuple[np.ndarray, np.ndarray]:
-    """Slot window, known head, committee resolution, dedup.
-    Returns (attesting indices, committee)."""
+def attesting_indices(state, att, preset) -> Tuple[np.ndarray, np.ndarray]:
+    """(attesting indices, committee) for an attestation — the committee
+    lookup + aggregation-bit select shared by gossip verification and
+    block-import fork-choice feeding."""
+    committee = np.asarray(get_beacon_committee(
+        state, int(att.data.slot), int(att.data.index), preset))
+    bits = np.asarray(att.aggregation_bits, dtype=bool)[:len(committee)]
+    return committee[bits], committee
+
+
+def _cheap_checks(chain, att) -> Tuple[np.ndarray, np.ndarray, object]:
+    """Slot window, known head, committee resolution, first-seen PEEK.
+    Attesters are only RECORDED after the batch signature verifies —
+    otherwise junk signatures naming honest validators would censor their
+    real attestations (same two-phase as observed_block_producers).
+    Returns (attesting indices, committee, resolved state)."""
     slot = int(att.data.slot)
     cur = chain.current_slot()
     if not (slot <= cur <= slot + ATTESTATION_PROPAGATION_SLOT_RANGE):
@@ -51,21 +64,17 @@ def _cheap_checks(chain, att) -> Tuple[np.ndarray, np.ndarray]:
     if not chain.fork_choice.contains_block(head_root):
         raise UnknownHeadBlock(head_root.hex())
     state = chain.state_for_attestation(att)
-    committee = np.asarray(get_beacon_committee(
-        state, slot, int(att.data.index), chain.preset))
-    bits = np.asarray(att.aggregation_bits, dtype=bool)[:len(committee)]
-    indices = committee[bits]
+    indices, committee = attesting_indices(state, att, chain.preset)
     epoch = int(att.data.target.epoch)
     fresh = [i for i in indices
-             if chain.observed_attesters.observe(epoch, int(i))]
+             if not chain.observed_attesters.has_attested(epoch, int(i))]
     if not fresh:
         raise PriorAttestationKnown(
             f"all {len(indices)} attesters already seen for epoch {epoch}")
-    return indices, committee
+    return indices, committee, state
 
 
-def _signature_set(chain, att, indices) -> bls.SignatureSet:
-    state = chain.state_for_attestation(att)
+def _signature_set(chain, att, indices, state) -> bls.SignatureSet:
     return sigs.indexed_attestation_signature_set(
         state, [int(i) for i in indices], bytes(att.signature), att.data,
         chain.pubkey_cache, chain.preset)
@@ -80,22 +89,28 @@ def batch_verify_attestations(chain, attestations: List
     results: List = [None] * len(attestations)
     for i, att in enumerate(attestations):
         try:
-            indices, committee = _cheap_checks(chain, att)
-            staged.append((i, att, indices, committee))
+            indices, committee, state = _cheap_checks(chain, att)
+            staged.append((i, att, indices, committee, state))
         except AttestationError as e:
             results[i] = (None, e)
+
+    def accept(i, att, idx, committee):
+        epoch = int(att.data.target.epoch)
+        for v in idx:  # record only on success (two-phase)
+            chain.observed_attesters.observe(epoch, int(v))
+        results[i] = (VerifiedAttestation(att, idx, committee), None)
+
     if staged:
-        sets = [_signature_set(chain, att, idx)
-                for (_, att, idx, _) in staged]
+        sets = [_signature_set(chain, att, idx, state)
+                for (_, att, idx, _, state) in staged]
         if bls.verify_signature_sets(sets):
-            for (i, att, idx, committee) in staged:
-                results[i] = (VerifiedAttestation(att, idx, committee), None)
+            for (i, att, idx, committee, _state) in staged:
+                accept(i, att, idx, committee)
         else:
             # Fallback: verify one-by-one (`batch.rs:203`).
-            for (i, att, idx, committee), sset in zip(staged, sets):
+            for (i, att, idx, committee, _state), sset in zip(staged, sets):
                 if bls.verify_signature_sets([sset]):
-                    results[i] = (VerifiedAttestation(att, idx, committee),
-                                  None)
+                    accept(i, att, idx, committee)
                 else:
                     results[i] = (None, AttestationSignatureInvalid(
                         f"attestation {i} signature invalid"))

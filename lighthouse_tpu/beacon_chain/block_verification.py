@@ -30,6 +30,7 @@ from .errors import (
     BlockIsAlreadyKnown,
     FutureSlot,
     IncorrectProposer,
+    InvalidBlock,
     InvalidSignatures,
     ParentUnknown,
     ProposalSignatureInvalid,
@@ -114,6 +115,9 @@ class ExecutedBlock:
         (`block_verification.rs:1104`): one transition with ``VERIFY_BULK``
         (non-proposal signatures batched into one device verify during
         execution), then the post-state root check (`:1423`)."""
+        from ..state_transition.per_block import BlockProcessingError
+        from ..ssz.core import SszError
+
         block = sv.signed_block.message
         state = sv.parent_state
         try:
@@ -124,8 +128,13 @@ class ExecutedBlock:
                           strategy=SignatureStrategy.VERIFY_BULK,
                           pubkey_cache=chain.pubkey_cache,
                           payload_verifier=chain.payload_verifier)
-        except Exception as e:
-            raise InvalidSignatures(f"state transition failed: {e}") from e
+        except (BlockProcessingError, SszError, ValueError) as e:
+            # Signature batch failures are InvalidSignatures; every other
+            # transition rejection keeps its own label.  Programming errors
+            # (TypeError/AttributeError/...) propagate unwrapped.
+            if "signature" in str(e).lower():
+                raise InvalidSignatures(str(e)) from e
+            raise InvalidBlock(str(e)) from e
         root = state.tree_hash_root()
         if root != bytes(block.state_root):
             raise StateRootMismatch(

@@ -70,6 +70,7 @@ class BeaconChain:
                         genesis_block_root)
         self._states_by_block: dict[bytes, object] = {
             genesis_block_root: genesis_state.copy()}
+        self._advanced_states: dict = {}
         self.head = CanonicalHead(root=genesis_block_root,
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
@@ -105,13 +106,20 @@ class BeaconChain:
 
     def state_for_attestation(self, att):
         """A state able to compute the attestation's committee — the head
-        state advanced if needed (shuffling/attester cache role)."""
+        state advanced if needed, memoised per (head, slot) so a 64-item
+        gossip batch advances once (shuffling/attester cache role)."""
         state = self.head.state
         slot = int(att.data.slot)
-        if int(state.slot) < slot:
-            state = process_slots(state.copy(), slot, self.preset, self.spec,
-                                  self.T)
-        return state
+        if int(state.slot) >= slot:
+            return state
+        key = (self.head.root, slot)
+        cached = self._advanced_states.get(key)
+        if cached is None:
+            cached = process_slots(state.copy(), slot, self.preset,
+                                   self.spec, self.T)
+            self._advanced_states.clear()  # keep only the latest head/slot
+            self._advanced_states[key] = cached
+        return cached
 
     # -- block import pipeline ----------------------------------------------
 
@@ -136,16 +144,12 @@ class BeaconChain:
         self._states_by_block[block_root] = state
         # Feed block attestations to fork choice (`beacon_chain.rs:
         # apply_attestation_to_fork_choice` via import).
+        from .attestation_verification import attesting_indices
         for att in ex.signed_block.message.body.attestations:
             try:
-                from ..state_transition.committees import get_beacon_committee
-                committee = np.asarray(get_beacon_committee(
-                    state, int(att.data.slot), int(att.data.index),
-                    self.preset))
-                bits = np.asarray(att.aggregation_bits,
-                                  dtype=bool)[:len(committee)]
+                idx, _committee = attesting_indices(state, att, self.preset)
                 self.fork_choice.on_attestation(_Indexed(
-                    att.data, committee[bits].tolist()), is_from_block=True)
+                    att.data, idx.tolist()), is_from_block=True)
             except Exception:
                 pass  # block attestations are best-effort for fork choice
         self.recompute_head()

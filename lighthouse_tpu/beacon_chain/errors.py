@@ -38,6 +38,10 @@ class StateRootMismatch(BlockError):
     pass
 
 
+class InvalidBlock(BlockError):
+    """The state transition rejected the block (non-signature reason)."""
+
+
 class RepeatProposal(BlockError):
     pass
 

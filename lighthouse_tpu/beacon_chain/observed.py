@@ -26,6 +26,10 @@ class ObservedAttesters:
         seen.add(validator_index)
         return True
 
+    def has_attested(self, epoch: int, validator_index: int) -> bool:
+        """Peek (no recording) — the doppelganger liveness probe."""
+        return validator_index in self._by_epoch.get(epoch, set())
+
     def prune(self, current_epoch: int) -> None:
         for e in [e for e in self._by_epoch
                   if e + self.horizon < current_epoch]:

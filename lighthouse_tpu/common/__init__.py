@@ -1,0 +1,9 @@
+"""Cross-cutting commons (counterpart of ``common/*``): metrics registry,
+structured logging, slot clocks."""
+
+from .logging import Logger, test_logger
+from .metrics import REGISTRY, Registry, start_timer
+from .slot_clock import ManualSlotClock, SlotClock, SystemTimeSlotClock
+
+__all__ = ["Logger", "test_logger", "REGISTRY", "Registry", "start_timer",
+           "SlotClock", "SystemTimeSlotClock", "ManualSlotClock"]

@@ -1,0 +1,145 @@
+"""Process-global metrics registry — ``common/lighthouse_metrics``
+(``/root/reference/common/lighthouse_metrics/src/lib.rs:2-37,69-137``):
+counters, gauges and histograms created lazily by name, ``start_timer`` /
+``stop_timer`` guards around hot sections, and Prometheus text encoding
+(the scrape surface of ``beacon_node/http_metrics``)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name, self.help = name, help_
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, by: float = 1.0) -> None:
+        with self._lock:
+            self.value += by
+
+    def encode(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self.value}\n")
+
+
+class Gauge(Counter):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+    def encode(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self.value}\n")
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str,
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def start_timer(self) -> "HistogramTimer":
+        return HistogramTimer(self)
+
+    def encode(self) -> str:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        cum += self.counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {self.sum}")
+        out.append(f"{self.name}_count {self.total}")
+        return "\n".join(out) + "\n"
+
+
+class HistogramTimer:
+    """`start_timer`/`stop_timer` guard; also a context manager."""
+
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+        self.t0 = time.perf_counter()
+        self.stopped = False
+
+    def stop(self) -> float:
+        if not self.stopped:
+            dt = time.perf_counter() - self.t0
+            self.hist.observe(dt)
+            self.stopped = True
+            return dt
+        return 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help_: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as "
+                                f"{type(m).__name__}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "", **kw) -> Histogram:
+        return self._get(Histogram, name, help_, **kw)
+
+    def encode(self) -> str:
+        """Prometheus text exposition (the `/metrics` body)."""
+        with self._lock:
+            return "".join(m.encode()
+                           for _, m in sorted(self._metrics.items()))
+
+
+# The process-global registry (`lighthouse_metrics` lazy_static).
+REGISTRY = Registry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+
+
+def start_timer(name: str, help_: str = "") -> HistogramTimer:
+    return REGISTRY.histogram(name, help_).start_timer()

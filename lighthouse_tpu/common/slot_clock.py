@@ -1,0 +1,63 @@
+"""Slot clocks — ``common/slot_clock``
+(``/root/reference/common/slot_clock/src/``): the ``SlotClock`` trait with
+a wall-clock implementation and the manually-driven test clock every
+harness uses (``TestingSlotClock``)."""
+
+from __future__ import annotations
+
+import time
+
+
+class SlotClock:
+    """Trait: genesis-anchored slot arithmetic."""
+
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> int:
+        raise NotImplementedError
+
+    def slot_of(self, timestamp: float) -> int:
+        if timestamp < self.genesis_time:
+            return 0
+        return int(timestamp - self.genesis_time) // self.seconds_per_slot
+
+    def start_of(self, slot: int) -> int:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def seconds_into_slot(self, timestamp: float) -> float:
+        return (timestamp - self.genesis_time) % self.seconds_per_slot
+
+
+class SystemTimeSlotClock(SlotClock):
+    """`SystemTimeSlotClock` — wall clock."""
+
+    def now(self) -> int:
+        return self.slot_of(time.time())
+
+    def duration_to_next_slot(self) -> float:
+        t = time.time()
+        return self.start_of(self.slot_of(t) + 1) - t
+
+
+class ManualSlotClock(SlotClock):
+    """`ManualSlotClock`/`TestingSlotClock` — tests drive time."""
+
+    def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12,
+                 slot: int = 0):
+        super().__init__(genesis_time, seconds_per_slot)
+        self._slot = slot
+
+    def now(self) -> int:
+        return self._slot
+
+    def set_slot(self, slot: int) -> None:
+        self._slot = slot
+
+    def advance(self, n: int = 1) -> int:
+        self._slot += n
+        return self._slot
+
+    def duration_to_next_slot(self) -> float:
+        return 0.0

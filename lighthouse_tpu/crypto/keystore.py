@@ -1,0 +1,123 @@
+"""EIP-2335 BLS keystores — ``crypto/eth2_keystore``
+(``/root/reference/crypto/eth2_keystore/src/``): scrypt or pbkdf2 key
+derivation, AES-128-CTR encryption, SHA-256 checksum, JSON wire format,
+NFKD password normalization with control-character stripping."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+import unicodedata
+import uuid as uuid_mod
+from dataclasses import dataclass
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+
+class KeystoreError(ValueError):
+    pass
+
+
+def normalize_password(password: str) -> bytes:
+    """NFKD + strip C0/C1/DEL control chars (`eth2_keystore` password
+    rules)."""
+    norm = unicodedata.normalize("NFKD", password)
+    return "".join(c for c in norm
+                   if not unicodedata.category(c) == "Cc"
+                   and c != "\x7f").encode("utf-8")
+
+
+def _derive_key(password: bytes, kdf: dict) -> bytes:
+    params = kdf["params"]
+    salt = bytes.fromhex(params["salt"])
+    if kdf["function"] == "scrypt":
+        return hashlib.scrypt(password, salt=salt, n=params["n"],
+                              r=params["r"], p=params["p"],
+                              dklen=params["dklen"], maxmem=2**31 - 1)
+    if kdf["function"] == "pbkdf2":
+        if params.get("prf", "hmac-sha256") != "hmac-sha256":
+            raise KeystoreError("unsupported prf")
+        return hashlib.pbkdf2_hmac("sha256", password, salt, params["c"],
+                                   dklen=params["dklen"])
+    raise KeystoreError(f"unknown kdf {kdf['function']}")
+
+
+def _aes128_ctr(key16: bytes, iv: bytes, data: bytes) -> bytes:
+    c = Cipher(algorithms.AES(key16), modes.CTR(iv)).encryptor()
+    return c.update(data) + c.finalize()
+
+
+@dataclass
+class Keystore:
+    """One encrypted secret key (JSON-roundtrippable)."""
+    crypto: dict
+    pubkey: str
+    path: str
+    uuid: str
+    version: int = 4
+    description: str = ""
+
+    @classmethod
+    def encrypt(cls, secret: bytes, password: str, *, pubkey: bytes,
+                path: str = "", kdf: str = "scrypt",
+                scrypt_n: int = 262144) -> "Keystore":
+        """`Keystore::encrypt` — scrypt (default) or pbkdf2."""
+        pw = normalize_password(password)
+        salt = secrets.token_bytes(32)
+        if kdf == "scrypt":
+            kdf_module = {"function": "scrypt",
+                          "params": {"dklen": 32, "n": scrypt_n, "p": 1,
+                                     "r": 8, "salt": salt.hex()},
+                          "message": ""}
+        elif kdf == "pbkdf2":
+            kdf_module = {"function": "pbkdf2",
+                          "params": {"dklen": 32, "c": 262144,
+                                     "prf": "hmac-sha256",
+                                     "salt": salt.hex()},
+                          "message": ""}
+        else:
+            raise KeystoreError(f"unknown kdf {kdf}")
+        dk = _derive_key(pw, kdf_module)
+        iv = secrets.token_bytes(16)
+        ciphertext = _aes128_ctr(dk[:16], iv, secret)
+        checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
+        crypto = {
+            "kdf": kdf_module,
+            "checksum": {"function": "sha256", "params": {},
+                         "message": checksum.hex()},
+            "cipher": {"function": "aes-128-ctr",
+                       "params": {"iv": iv.hex()},
+                       "message": ciphertext.hex()},
+        }
+        return cls(crypto=crypto, pubkey=pubkey.hex(), path=path,
+                   uuid=str(uuid_mod.uuid4()))
+
+    def decrypt(self, password: str) -> bytes:
+        """`Keystore::decrypt` — checksum-gated."""
+        pw = normalize_password(password)
+        dk = _derive_key(pw, self.crypto["kdf"])
+        ciphertext = bytes.fromhex(self.crypto["cipher"]["message"])
+        checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
+        if checksum.hex() != self.crypto["checksum"]["message"]:
+            raise KeystoreError("invalid password (checksum mismatch)")
+        if self.crypto["cipher"]["function"] != "aes-128-ctr":
+            raise KeystoreError("unsupported cipher")
+        iv = bytes.fromhex(self.crypto["cipher"]["params"]["iv"])
+        return _aes128_ctr(dk[:16], iv, ciphertext)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "crypto": self.crypto, "description": self.description,
+            "pubkey": self.pubkey, "path": self.path, "uuid": self.uuid,
+            "version": self.version})
+
+    @classmethod
+    def from_json(cls, data: str) -> "Keystore":
+        obj = json.loads(data)
+        if obj.get("version") != 4:
+            raise KeystoreError("only version-4 keystores supported")
+        return cls(crypto=obj["crypto"], pubkey=obj.get("pubkey", ""),
+                   path=obj.get("path", ""), uuid=obj.get("uuid", ""),
+                   version=4, description=obj.get("description", ""))

@@ -1,0 +1,24 @@
+"""Node networking: BeaconProcessor scheduler + in-process gossip/RPC
+(counterparts of ``beacon_node/network`` and the node-side architecture of
+``beacon_node/lighthouse_network``)."""
+
+from .beacon_processor import (
+    BeaconProcessor,
+    QUEUE_SPECS,
+    WorkEvent,
+    WorkType,
+)
+from .service import (
+    ATTESTATION_SUBNET_COUNT,
+    BlocksByRangeRequest,
+    GossipBus,
+    NetworkNode,
+    TOPIC_AGGREGATE,
+    TOPIC_BLOCK,
+)
+
+__all__ = [
+    "BeaconProcessor", "WorkEvent", "WorkType", "QUEUE_SPECS",
+    "GossipBus", "NetworkNode", "BlocksByRangeRequest",
+    "TOPIC_BLOCK", "TOPIC_AGGREGATE", "ATTESTATION_SUBNET_COUNT",
+]

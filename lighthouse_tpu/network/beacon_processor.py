@@ -1,0 +1,201 @@
+"""Prioritized bounded work-queue scheduler — the ``BeaconProcessor``
+(``/root/reference/beacon_node/network/src/beacon_processor/mod.rs:86-228,
+978-1130``).
+
+One manager drains a fixed-priority array of bounded per-work-type queues
+into a worker pool (≤ ``max_workers``).  Gossip attestation/aggregate
+queues BATCH up to 64 items into one work event (``mod.rs:200-201``) — the
+shape the TPU batch-verify path wants.  Early/unresolvable work goes to a
+delay queue and re-enters later (``work_reprocessing_queue.rs:46-177``).
+
+Queue discipline follows the reference: LIFO for latency-sensitive gossip
+(newest first — old gossip decays in value), FIFO for sync/backfill
+correctness.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from ..common.metrics import REGISTRY
+
+
+class WorkType(str, Enum):
+    """Priority order = declaration order (`mod.rs:978` match order)."""
+    ChainSegment = "chain_segment"
+    GossipBlock = "gossip_block"
+    GossipAggregateBatch = "gossip_aggregate_batch"
+    GossipAttestationBatch = "gossip_attestation_batch"
+    Rpc = "rpc"
+    GossipVoluntaryExit = "gossip_voluntary_exit"
+    GossipSlashing = "gossip_slashing"
+    BackfillSync = "backfill_sync"
+
+
+# (max queue length, lifo?, batch size) per work type — bounds from
+# `mod.rs:86-228` (scaled), batching from `:200-201`.
+QUEUE_SPECS: Dict[WorkType, Tuple[int, bool, int]] = {
+    WorkType.ChainSegment: (64, False, 1),
+    WorkType.GossipBlock: (1024, False, 1),
+    WorkType.GossipAggregateBatch: (4096, True, 64),
+    WorkType.GossipAttestationBatch: (16384, True, 64),
+    WorkType.Rpc: (1024, False, 1),
+    WorkType.GossipVoluntaryExit: (4096, True, 1),
+    WorkType.GossipSlashing: (4096, True, 1),
+    WorkType.BackfillSync: (64, False, 1),
+}
+
+
+@dataclass
+class WorkEvent:
+    work_type: WorkType
+    payload: object
+    process_fn: Callable  # fn(payload) or fn([payloads]) for batched types
+
+
+class BeaconProcessor:
+    """Manager + bounded queues + worker pool."""
+
+    def __init__(self, max_workers: int = 4):
+        self.max_workers = max_workers
+        self.queues: Dict[WorkType, Deque[WorkEvent]] = {
+            wt: deque() for wt in WorkType}
+        self.dropped: Dict[WorkType, int] = {wt: 0 for wt in WorkType}
+        self._lock = threading.Condition()
+        self._reprocess: List[Tuple[float, int, WorkEvent]] = []
+        self._seq = 0
+        self._active = 0
+        self._shutdown = False
+        self._workers: List[threading.Thread] = []
+        self._manager: Optional[threading.Thread] = None
+        self._m_processed = REGISTRY.counter(
+            "beacon_processor_events_total", "work events processed")
+        self._m_dropped = REGISTRY.counter(
+            "beacon_processor_events_dropped_total", "work events dropped")
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, event: WorkEvent) -> bool:
+        """Enqueue; full queues drop (oldest for LIFO, newest for FIFO —
+        `mod.rs` drop policies).  Returns False when dropped."""
+        maxlen, lifo, _batch = QUEUE_SPECS[event.work_type]
+        with self._lock:
+            q = self.queues[event.work_type]
+            if len(q) >= maxlen:
+                self.dropped[event.work_type] += 1
+                self._m_dropped.inc()
+                if lifo:
+                    q.popleft()  # drop the OLDEST, keep the fresh item
+                else:
+                    return False  # FIFO: reject the newcomer
+            q.append(event)
+            self._lock.notify_all()
+        return True
+
+    def defer(self, event: WorkEvent, delay_s: float) -> None:
+        """Delay-queue entry (`work_reprocessing_queue.rs` DelayQueue):
+        early blocks / unknown-parent attestations re-enter later."""
+        with self._lock:
+            self._seq += 1
+            heapq.heappush(self._reprocess,
+                           (time.monotonic() + delay_s, self._seq, event))
+            self._lock.notify_all()
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _pop_next(self) -> Optional[WorkEvent]:
+        """Highest-priority nonempty queue; batched types coalesce up to
+        their batch size into ONE event."""
+        now = time.monotonic()
+        while self._reprocess and self._reprocess[0][0] <= now:
+            _, _, ev = heapq.heappop(self._reprocess)
+            self.queues[ev.work_type].append(ev)
+        for wt in WorkType:
+            q = self.queues[wt]
+            if not q:
+                continue
+            maxlen, lifo, batch = QUEUE_SPECS[wt]
+            if batch <= 1:
+                return q.pop() if lifo else q.popleft()
+            events = []
+            while q and len(events) < batch:
+                events.append(q.pop() if lifo else q.popleft())
+            fn = events[0].process_fn
+            return WorkEvent(wt, [e.payload for e in events],
+                             lambda batch_payloads, fn=fn:
+                             fn(batch_payloads))
+        return None
+
+    def run_until_idle(self, timeout: float = 10.0) -> int:
+        """Synchronous drain (tests, simulator): process everything
+        currently queued (+ anything its processing enqueues), inline.
+        Returns the number of work events processed."""
+        processed = 0
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                ev = self._pop_next()
+            if ev is None:
+                if self._reprocess:
+                    t = self._reprocess[0][0] - time.monotonic()
+                    if t > 0 and time.monotonic() + t < deadline:
+                        time.sleep(min(t, 0.05))
+                        continue
+                break
+            ev.process_fn(ev.payload)
+            self._m_processed.inc()
+            processed += 1
+        return processed
+
+    # -- threaded mode -------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn the manager + workers (production mode)."""
+        if self._manager is not None:
+            return
+        self._shutdown = False
+        self._manager = threading.Thread(target=self._manager_loop,
+                                         daemon=True)
+        self._manager.start()
+
+    def _manager_loop(self) -> None:
+        pool: List[threading.Thread] = []
+        while True:
+            with self._lock:
+                if self._shutdown:
+                    return
+                ev = self._pop_next()
+                if ev is None:
+                    self._lock.wait(timeout=0.05)
+                    continue
+                while self._active >= self.max_workers:
+                    self._lock.wait(timeout=0.05)
+                    if self._shutdown:
+                        return
+                self._active += 1
+            t = threading.Thread(target=self._run_one, args=(ev,),
+                                 daemon=True)
+            t.start()
+
+    def _run_one(self, ev: WorkEvent) -> None:
+        try:
+            ev.process_fn(ev.payload)
+            self._m_processed.inc()
+        finally:
+            with self._lock:
+                self._active -= 1
+                self._lock.notify_all()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+        if self._manager is not None:
+            self._manager.join(timeout=2)
+            self._manager = None

@@ -1,0 +1,158 @@
+"""In-process networking: gossip bus, Req/Resp, node router, sync.
+
+The internet-facing stack of the reference is libp2p (gossipsub + SSZ-snappy
+Req/Resp + discv5 — ``beacon_node/lighthouse_network``); this module is the
+node-side architecture — topics, router, BeaconProcessor dispatch, range
+sync — over an in-process message bus, the shape the reference itself uses
+for multi-node testing (``testing/node_test_rig``, ``testing/simulator``).
+A production wire transport plugs in at the :class:`GossipBus` /
+:class:`ReqRespClient` seams.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..beacon_chain import BeaconChain, BlockError, ParentUnknown
+from ..common.logging import Logger, test_logger
+from .beacon_processor import BeaconProcessor, WorkEvent, WorkType
+
+# Gossip topic names (`lighthouse_network/src/types/topics.rs:11-26`).
+TOPIC_BLOCK = "beacon_block"
+TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
+TOPIC_ATTESTATION_SUBNET = "beacon_attestation_{}"
+TOPIC_EXIT = "voluntary_exit"
+TOPIC_PROPOSER_SLASHING = "proposer_slashing"
+TOPIC_ATTESTER_SLASHING = "attester_slashing"
+ATTESTATION_SUBNET_COUNT = 64
+
+
+class GossipBus:
+    """In-process gossipsub: publish floods every other subscriber."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable]] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, topic: str, handler: Callable) -> None:
+        with self._lock:
+            self._subs.setdefault(topic, []).append(handler)
+
+    def publish(self, topic: str, message, *, exclude=None) -> None:
+        with self._lock:
+            handlers = list(self._subs.get(topic, []))
+        for h in handlers:
+            if h is not exclude:
+                h(message)
+
+
+@dataclass
+class BlocksByRangeRequest:
+    """`BlocksByRange` (`rpc/protocol.rs:161-179`)."""
+    start_slot: int
+    count: int
+
+
+class NetworkNode:
+    """One node: chain + processor + router + sync
+    (``beacon_node/network/src/router/`` + ``sync/``)."""
+
+    def __init__(self, chain: BeaconChain, bus: GossipBus,
+                 name: str = "node", log: Optional[Logger] = None):
+        self.chain = chain
+        self.bus = bus
+        self.name = name
+        self.log = (log or test_logger()).child(name)
+        self.processor = BeaconProcessor()
+        self.peers: List["NetworkNode"] = []
+        self._block_handler = self._on_gossip_block
+        bus.subscribe(TOPIC_BLOCK, self._block_handler)
+        self._att_handler = self._on_gossip_attestation
+        bus.subscribe(TOPIC_AGGREGATE, self._att_handler)
+
+    # -- publishing ----------------------------------------------------------
+
+    def publish_block(self, signed_block) -> None:
+        """Broadcast-then-self-import (`http_api/publish_blocks.rs`)."""
+        self.bus.publish(TOPIC_BLOCK, signed_block,
+                         exclude=self._block_handler)
+        self._on_gossip_block(signed_block)
+
+    def publish_attestations(self, atts: List) -> None:
+        self.bus.publish(TOPIC_AGGREGATE, atts, exclude=self._att_handler)
+        self._on_gossip_attestation(atts)
+
+    # -- gossip handlers → processor queues ----------------------------------
+
+    def _on_gossip_block(self, signed_block) -> None:
+        self.processor.submit(WorkEvent(
+            WorkType.GossipBlock, signed_block, self._process_block))
+
+    def _on_gossip_attestation(self, atts: List) -> None:
+        for att in atts:
+            self.processor.submit(WorkEvent(
+                WorkType.GossipAttestationBatch, att,
+                self._process_attestation_batch))
+
+    def _process_block(self, signed_block) -> None:
+        slot = int(signed_block.message.slot)
+        self.chain.per_slot_task(max(slot, self.chain.current_slot()))
+        try:
+            self.chain.process_block(signed_block, is_timely=True)
+            self.log.debug("block imported", slot=slot)
+        except ParentUnknown:
+            # Parent lookup (`block_lookups/`): range-sync from a peer,
+            # retry via the reprocess queue.
+            self.log.debug("unknown parent; range syncing", slot=slot)
+            if self._range_sync(slot):
+                self.processor.defer(WorkEvent(
+                    WorkType.GossipBlock, signed_block,
+                    self._process_block), 0.0)
+        except BlockError as e:
+            self.log.warn("block rejected", slot=slot,
+                          reason=type(e).__name__)
+
+    def _process_attestation_batch(self, atts: List) -> None:
+        self.chain.process_attestation_batch(atts)
+
+    # -- Req/Resp ------------------------------------------------------------
+
+    def blocks_by_range(self, req: BlocksByRangeRequest) -> List:
+        """Serve `BlocksByRange` from the canonical chain."""
+        out = []
+        root = self.chain.head.root
+        while root in self.chain.fork_choice.proto.indices:
+            block = self.chain.store.get_block(root)
+            if block is None:
+                break
+            slot = int(block.message.slot)
+            if slot < req.start_slot:
+                break
+            if slot < req.start_slot + req.count:
+                out.append(block)
+            root = bytes(block.message.parent_root)
+        out.reverse()
+        return out
+
+    def _range_sync(self, target_slot: int) -> bool:
+        """Minimal `range_sync`: pull the missing span from the first peer
+        ahead of us and import as a chain segment."""
+        start = self.chain.head.slot + 1
+        for peer in self.peers:
+            if peer.chain.head.slot < start:
+                continue
+            blocks = peer.blocks_by_range(BlocksByRangeRequest(
+                start_slot=start, count=max(target_slot - start, 1)))
+            ok = False
+            for b in blocks:
+                try:
+                    self.chain.per_slot_task(int(b.message.slot))
+                    self.chain.process_block(b)
+                    ok = True
+                except BlockError:
+                    pass
+            if ok:
+                return True
+        return False

@@ -94,11 +94,17 @@ class OperationPool:
         slot = int(state.slot)
         epoch = slot // self.preset.SLOTS_PER_EPOCH
         balances = state.validators.col("effective_balance")
-        # Validators already credited this epoch cover nothing new.
-        seen: set[int] = set()
-        part = np.asarray(state.current_epoch_participation)
-        if part.size:
-            seen.update(np.nonzero(part)[0].tolist())
+        # Freshness is per-epoch: an attestation for epoch E only rewards
+        # validators not yet credited in E's participation flags
+        # (current vs previous — mixing them mis-weights boundary packing).
+        seen_cur: set[int] = set()
+        seen_prev: set[int] = set()
+        cur_part = np.asarray(state.current_epoch_participation)
+        if cur_part.size:
+            seen_cur.update(np.nonzero(cur_part)[0].tolist())
+        prev_part = np.asarray(state.previous_epoch_participation)
+        if prev_part.size:
+            seen_prev.update(np.nonzero(prev_part)[0].tolist())
         candidates = []
         for entry in self.attestations.values():
             for stored in entry:
@@ -108,6 +114,7 @@ class OperationPool:
                     continue
                 if att_epoch not in (epoch, epoch - 1):
                     continue
+                seen = seen_cur if att_epoch == epoch else seen_prev
                 idx = stored.committee[stored.bits[:len(stored.committee)]]
                 fresh = np.asarray([i for i in idx if int(i) not in seen],
                                    dtype=np.int64)

@@ -114,14 +114,20 @@ class HotColdDB:
         slot = int(state.slot)
         if slot % self.preset.SLOTS_PER_EPOCH == 0:
             self._put_full_state(DBColumn.BeaconState, state_root, state)
-        else:
-            boundary_slot = (slot // self.preset.SLOTS_PER_EPOCH
-                             * self.preset.SLOTS_PER_EPOCH)
-            boundary_root = bytes(state.state_roots.get(
-                boundary_slot % self.preset.SLOTS_PER_HISTORICAL_ROOT))
-            summary = HotStateSummary(slot, latest_block_root, boundary_root)
-            self.kv.put(DBColumn.BeaconStateSummary, state_root,
-                        summary.encode())
+            return
+        boundary_slot = (slot // self.preset.SLOTS_PER_EPOCH
+                         * self.preset.SLOTS_PER_EPOCH)
+        boundary_root = bytes(state.state_roots.get(
+            boundary_slot % self.preset.SLOTS_PER_HISTORICAL_ROOT))
+        if self.kv.get(DBColumn.BeaconState, boundary_root) is None:
+            # The epoch boundary was a skipped slot (no block → no stored
+            # post-state there): a summary would be unloadable, so store
+            # this state fully instead (self-contained).
+            self._put_full_state(DBColumn.BeaconState, state_root, state)
+            return
+        summary = HotStateSummary(slot, latest_block_root, boundary_root)
+        self.kv.put(DBColumn.BeaconStateSummary, state_root,
+                    summary.encode())
 
     def _put_full_state(self, col: DBColumn, state_root: bytes, state) -> None:
         fork = self.T.fork_of_state(state)
@@ -140,13 +146,13 @@ class HotColdDB:
         state = self._get_full_state(DBColumn.BeaconState, state_root)
         if state is not None:
             return state
+        state = self._get_full_state(DBColumn.ColdState, state_root)
+        if state is not None:
+            return state
         summary_data = self.kv.get(DBColumn.BeaconStateSummary, state_root)
         if summary_data is not None:
             return self._replay_from_summary(
                 HotStateSummary.decode(summary_data))
-        state = self._get_full_state(DBColumn.ColdState, state_root)
-        if state is not None:
-            return state
         return None
 
     def _block_chain_to(self, latest_block_root: bytes,
@@ -167,6 +173,10 @@ class HotColdDB:
     def _replay_from_summary(self, summary: HotStateSummary):
         base = self._get_full_state(DBColumn.BeaconState,
                                     summary.epoch_boundary_state_root)
+        if base is None:
+            # Boundary state may have migrated to the freezer.
+            base = self._get_full_state(DBColumn.ColdState,
+                                        summary.epoch_boundary_state_root)
         if base is None:
             raise StoreError("missing epoch boundary state for summary")
         blocks = self._block_chain_to(summary.latest_block_root,
@@ -194,20 +204,18 @@ class HotColdDB:
             if data is not None:
                 ops.append(("put", DBColumn.ColdBlock, root, data))
                 ops.append(("delete", DBColumn.BeaconBlock, root, None))
-        # Hot states below the split: keep restore points, drop the rest.
+        # Hot full states below the split move to the freezer wholesale
+        # (denser than the reference's sparse restore points + replay, but
+        # every previously-stored state stays loadable — the summaries are
+        # kept, and their boundary lookups fall through to the cold tier).
         for state_root, data in list(self.kv.iter_column(DBColumn.BeaconState)):
             state_slot = self._peek_state_slot(data)
             if state_slot < finalized_slot:
+                ops.append(("put", DBColumn.ColdState, state_root, data))
                 if state_slot % self.sprp == 0:
-                    ops.append(("put", DBColumn.ColdState, state_root, data))
                     ops.append(("put", DBColumn.BeaconRestorePoint,
                                 struct.pack("<Q", state_slot), state_root))
                 ops.append(("delete", DBColumn.BeaconState, state_root, None))
-        for state_root, data in list(
-                self.kv.iter_column(DBColumn.BeaconStateSummary)):
-            if HotStateSummary.decode(data).slot < finalized_slot:
-                ops.append(("delete", DBColumn.BeaconStateSummary,
-                            state_root, None))
         self.kv.do_atomically(ops)
         self.split_slot = finalized_slot
         self._store_meta()

@@ -1,0 +1,229 @@
+"""Beacon-node handles for the validator client.
+
+``InProcessBeaconNode`` adapts a :class:`~lighthouse_tpu.beacon_chain.
+BeaconChain` to the duty/production/publish API the services consume (the
+``common/eth2`` typed HTTP client's role, minus the wire);
+``BeaconNodeFallback`` is the multi-node redundancy router
+(``validator_client/src/beacon_node_fallback.rs:317,465`` —
+``first_success`` over healthy nodes)."""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..state_transition.committees import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+)
+from ..state_transition.helpers import (
+    current_epoch,
+    get_block_root,
+    get_randao_mix,
+)
+from ..state_transition.per_block import get_expected_withdrawals
+from ..state_transition.per_slot import process_slots
+from ..types.chain_spec import ForkName
+
+
+@dataclass
+class ProposerDuty:
+    slot: int
+    validator_index: int
+
+
+@dataclass
+class AttesterDuty:
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_length: int
+    validator_index: int
+
+
+class BeaconNodeError(RuntimeError):
+    pass
+
+
+class InProcessBeaconNode:
+    """Direct-object beacon node (node_test_rig style)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.healthy = True
+
+    # -- info ----------------------------------------------------------------
+
+    def head_root(self) -> bytes:
+        return self.chain.head.root
+
+    def genesis_validators_root(self) -> bytes:
+        return bytes(self.chain.head.state.genesis_validators_root)
+
+    # -- duties --------------------------------------------------------------
+
+    def _epoch_state(self, epoch: int):
+        preset = self.chain.preset
+        state = self.chain.head.state
+        start = epoch * preset.SLOTS_PER_EPOCH
+        if int(state.slot) < start:
+            state = process_slots(state.copy(), start, preset,
+                                  self.chain.spec, self.chain.T)
+        return state
+
+    def proposer_duties(self, epoch: int) -> List[ProposerDuty]:
+        """`DutiesService` proposer poll (`duties_service.rs`)."""
+        preset = self.chain.preset
+        state = self._epoch_state(epoch)
+        out = []
+        for slot in range(epoch * preset.SLOTS_PER_EPOCH,
+                          (epoch + 1) * preset.SLOTS_PER_EPOCH):
+            out.append(ProposerDuty(
+                slot, get_beacon_proposer_index(state, preset, slot=slot)))
+        return out
+
+    def attester_duties(self, epoch: int,
+                        indices: Sequence[int]) -> List[AttesterDuty]:
+        preset = self.chain.preset
+        state = self._epoch_state(epoch)
+        want = set(int(i) for i in indices)
+        out = []
+        for slot in range(epoch * preset.SLOTS_PER_EPOCH,
+                          (epoch + 1) * preset.SLOTS_PER_EPOCH):
+            n_comm = get_committee_count_per_slot(state, epoch, preset)
+            for ci in range(n_comm):
+                committee = get_beacon_committee(state, slot, ci, preset)
+                for pos, vi in enumerate(committee):
+                    if int(vi) in want:
+                        out.append(AttesterDuty(
+                            slot, ci, pos, len(committee), int(vi)))
+        return out
+
+    def liveness(self, epoch: int, indices: Sequence[int]) -> List[bool]:
+        """Doppelganger probe: was the validator seen attesting this
+        epoch? (`/lighthouse/liveness` endpoint role)."""
+        seen = self.chain.observed_attesters
+        return [seen.has_attested(epoch, int(i)) for i in indices]
+
+    # -- attestation data ----------------------------------------------------
+
+    def attestation_data(self, slot: int, committee_index: int):
+        """`produce_unaggregated_attestation` (`beacon_chain.rs`)."""
+        chain = self.chain
+        preset = chain.preset
+        state = chain.head.state
+        if int(state.slot) < slot:
+            state = process_slots(state.copy(), slot, preset, chain.spec,
+                                  chain.T)
+        epoch = slot // preset.SLOTS_PER_EPOCH
+        if epoch * preset.SLOTS_PER_EPOCH == slot:
+            target_root = chain.head.root
+        else:
+            target_root = get_block_root(state, epoch, preset)
+        T = chain.T
+        return T.AttestationData(
+            slot=slot, index=committee_index,
+            beacon_block_root=chain.head.root,
+            source=state.current_justified_checkpoint,
+            target=T.Checkpoint(epoch=epoch, root=target_root))
+
+    # -- production ----------------------------------------------------------
+
+    def produce_block(self, slot: int, randao_reveal: bytes,
+                      graffiti: bytes = b"\x00" * 32):
+        """Unsigned block assembly from the pool + mock payload
+        (`produce_block_on_state`, `beacon_chain.rs:4133`; payload via the
+        MockExecutionLayer-style generator)."""
+        chain = self.chain
+        preset, spec, T = chain.preset, chain.spec, chain.T
+        parts = chain.produce_block_on_state(
+            chain.head.state.copy(), slot, randao_reveal, graffiti)
+        state = parts["state"]
+        fork = spec.fork_name_at_epoch(slot // preset.SLOTS_PER_EPOCH)
+        body_kw = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=graffiti.ljust(32, b"\x00"),
+            proposer_slashings=parts["proposer_slashings"],
+            attester_slashings=parts["attester_slashings"],
+            attestations=parts["attestations"],
+            deposits=[],
+            voluntary_exits=parts["voluntary_exits"],
+        )
+        if fork >= ForkName.ALTAIR:
+            body_kw["sync_aggregate"] = T.SyncAggregate(
+                sync_committee_bits=[False] * preset.SYNC_COMMITTEE_SIZE,
+                sync_committee_signature=b"\xc0" + b"\x00" * 95)
+        if fork >= ForkName.BELLATRIX:
+            body_kw["execution_payload"] = self._payload(state, fork)
+        if fork >= ForkName.CAPELLA:
+            body_kw["bls_to_execution_changes"] = parts[
+                "bls_to_execution_changes"]
+        body = T.body_cls(fork)(**body_kw)
+        block = T.block_cls(fork)(
+            slot=slot, proposer_index=parts["proposer_index"],
+            parent_root=parts["parent_root"], state_root=b"\x00" * 32,
+            body=body)
+        # Fill the state root (NoVerification scratch application).
+        from ..state_transition.per_block import (
+            SignatureStrategy, process_block)
+        scratch = state.copy()
+        dummy = T.signed_block_cls(fork)(
+            message=block, signature=b"\xc0" + b"\x00" * 95)
+        process_block(scratch, dummy, fork, preset, spec, T,
+                      strategy=SignatureStrategy.NO_VERIFICATION)
+        block.state_root = scratch.tree_hash_root()
+        return block
+
+    def _payload(self, state, fork: ForkName):
+        T, preset, spec = self.chain.T, self.chain.preset, self.chain.spec
+        parent_hash = bytes(state.latest_execution_payload_header.block_hash)
+        kw = dict(
+            parent_hash=parent_hash,
+            prev_randao=get_randao_mix(
+                state, current_epoch(state, preset), preset),
+            block_number=int(
+                state.latest_execution_payload_header.block_number) + 1,
+            gas_limit=30_000_000,
+            timestamp=int(state.genesis_time)
+            + int(state.slot) * spec.seconds_per_slot,
+            block_hash=hashlib.sha256(
+                parent_hash + int(state.slot).to_bytes(8, "little")).digest(),
+        )
+        if fork >= ForkName.CAPELLA:
+            kw["withdrawals"] = [
+                T.Withdrawal(index=w[0], validator_index=w[1], address=w[2],
+                             amount=w[3])
+                for w in get_expected_withdrawals(state, preset)]
+        return T.payload_cls(fork)(**kw)
+
+    # -- publication ---------------------------------------------------------
+
+    def publish_block(self, signed_block) -> bytes:
+        self.chain.per_slot_task(int(signed_block.message.slot))
+        return self.chain.process_block(signed_block, is_timely=True)
+
+    def submit_attestations(self, atts: List) -> None:
+        self.chain.process_attestation_batch(atts)
+
+
+class BeaconNodeFallback:
+    """`first_success` routing over candidate nodes."""
+
+    def __init__(self, nodes: List):
+        self.nodes = list(nodes)
+
+    def first_success(self, fn: Callable):
+        last_err: Optional[Exception] = None
+        for node in self.nodes:
+            if not getattr(node, "healthy", True):
+                continue
+            try:
+                return fn(node)
+            except Exception as e:  # noqa: BLE001 — try the next node
+                last_err = e
+        raise BeaconNodeError(f"all beacon nodes failed: {last_err}")

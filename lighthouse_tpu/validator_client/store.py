@@ -1,0 +1,76 @@
+"""Validator store: initialized keys + slashing-protected signing —
+``validator_client/src/validator_store.rs`` and
+``signing_method.rs:78-89`` (local-keystore signing; a remote-signer
+method slots into the same seam)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from ..crypto import bls
+from ..state_transition.helpers import compute_signing_root, get_domain
+from ..types.chain_spec import Domain
+from .slashing_protection import SlashingDatabase, SlashingProtectionError
+
+
+class ValidatorStore:
+    def __init__(self, slashing_db: Optional[SlashingDatabase] = None):
+        self.keys: Dict[bytes, bls.SecretKey] = {}  # pubkey → sk
+        self.index_by_pubkey: Dict[bytes, int] = {}
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self.doppelganger_blocked: set[bytes] = set()
+
+    # -- keys ----------------------------------------------------------------
+
+    def add_validator(self, sk: bls.SecretKey,
+                      index: Optional[int] = None) -> bytes:
+        pk = sk.public_key().serialize()
+        self.keys[pk] = sk
+        if index is not None:
+            self.index_by_pubkey[pk] = index
+        return pk
+
+    def import_keystore(self, keystore, password: str,
+                        index: Optional[int] = None) -> bytes:
+        secret = keystore.decrypt(password)
+        return self.add_validator(bls.SecretKey.deserialize(secret), index)
+
+    def pubkeys(self) -> List[bytes]:
+        return list(self.keys)
+
+    def indices(self) -> List[int]:
+        return [self.index_by_pubkey[pk] for pk in self.keys
+                if pk in self.index_by_pubkey]
+
+    # -- signing (slashing-protected) ---------------------------------------
+
+    def _check_doppelganger(self, pubkey: bytes) -> None:
+        if pubkey in self.doppelganger_blocked:
+            raise SlashingProtectionError(
+                "doppelganger protection: signing disabled")
+
+    def sign_block(self, pubkey: bytes, block, state, preset) -> bytes:
+        self._check_doppelganger(pubkey)
+        epoch = int(block.slot) // preset.SLOTS_PER_EPOCH
+        domain = get_domain(state, Domain.BEACON_PROPOSER, epoch, preset)
+        signing_root = compute_signing_root(block, domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            pubkey, int(block.slot), signing_root)
+        return self.keys[pubkey].sign(signing_root).serialize()
+
+    def sign_attestation(self, pubkey: bytes, data, state, preset) -> bytes:
+        self._check_doppelganger(pubkey)
+        domain = get_domain(state, Domain.BEACON_ATTESTER,
+                            int(data.target.epoch), preset)
+        signing_root = compute_signing_root(data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, int(data.source.epoch), int(data.target.epoch),
+            signing_root)
+        return self.keys[pubkey].sign(signing_root).serialize()
+
+    def sign_randao(self, pubkey: bytes, epoch: int, state, preset) -> bytes:
+        self._check_doppelganger(pubkey)
+        from ..ssz import uint64
+        domain = get_domain(state, Domain.RANDAO, epoch, preset)
+        root = compute_signing_root(uint64.hash_tree_root(epoch), domain)
+        return self.keys[pubkey].sign(root).serialize()

@@ -1,0 +1,159 @@
+"""Validator client: keystores/derivation, slashing protection, services.
+
+Mirrors `validator_client` + `slashing_protection` tests: EIP-2333 spec
+vectors, EIP-2335 roundtrip, EIP-3076 double/surround rules + interchange,
+and a full VC-over-chain slot loop that proposes and attests.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.crypto.key_derivation import (
+    derive_child_sk,
+    derive_master_sk,
+    derive_path,
+)
+from lighthouse_tpu.crypto.keystore import Keystore, KeystoreError
+from lighthouse_tpu.validator_client import (
+    InProcessBeaconNode,
+    SlashingDatabase,
+    SlashingProtectionError,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+def test_eip2333_spec_vectors():
+    """Test case 0 from the EIP-2333 specification."""
+    seed = bytes.fromhex(
+        "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e5349553"
+        "1f09a6987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04")
+    master = derive_master_sk(seed)
+    assert master == 6083874454709270928345386274498605044986640685124978867557563392430687146096
+    child = derive_child_sk(master, 0)
+    assert child == 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+
+def test_eip2333_path_derivation():
+    seed = b"\x01" * 32
+    direct = derive_child_sk(derive_master_sk(seed), 12381)
+    assert derive_path(seed, "m/12381") == direct
+    with pytest.raises(ValueError):
+        derive_path(seed, "x/1")
+
+
+def test_keystore_roundtrip_both_kdfs():
+    sk = B.SecretKey(0x1234)
+    pk = sk.public_key().serialize()
+    for kdf in ("scrypt", "pbkdf2"):
+        ks = Keystore.encrypt(sk.serialize(), "p@ssw0rd", pubkey=pk,
+                              path="m/12381/3600/0/0/0", kdf=kdf,
+                              scrypt_n=16384)
+        loaded = Keystore.from_json(ks.to_json())
+        assert loaded.decrypt("p@ssw0rd") == sk.serialize()
+        with pytest.raises(KeystoreError):
+            loaded.decrypt("wrong")
+
+
+def test_slashing_protection_rules():
+    db = SlashingDatabase()
+    pk = b"\x11" * 48
+    db.check_and_insert_block_proposal(pk, 10, b"\xaa" * 32)
+    # Same slot, same root: idempotent re-sign allowed.
+    db.check_and_insert_block_proposal(pk, 10, b"\xaa" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_block_proposal(pk, 10, b"\xbb" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_block_proposal(pk, 9, b"\xcc" * 32)
+
+    db.check_and_insert_attestation(pk, 2, 4, b"\x01" * 32)
+    with pytest.raises(SlashingProtectionError):  # double vote
+        db.check_and_insert_attestation(pk, 3, 4, b"\x02" * 32)
+    with pytest.raises(SlashingProtectionError):  # surrounds 2→4
+        db.check_and_insert_attestation(pk, 1, 5, b"\x03" * 32)
+    db.check_and_insert_attestation(pk, 4, 6, b"\x04" * 32)
+    with pytest.raises(SlashingProtectionError):  # surrounded by 4→6
+        db.check_and_insert_attestation(pk, 5, 5, b"\x05" * 32)
+
+
+def test_interchange_roundtrip():
+    db = SlashingDatabase()
+    pk = b"\x22" * 48
+    gvr = b"\x99" * 32
+    db.check_and_insert_block_proposal(pk, 5, b"\xaa" * 32)
+    db.check_and_insert_attestation(pk, 0, 3, b"\xbb" * 32)
+    payload = db.export_interchange(gvr)
+    db2 = SlashingDatabase()
+    assert db2.import_interchange(payload, gvr) == 2
+    with pytest.raises(SlashingProtectionError):
+        db2.check_and_insert_block_proposal(pk, 5, b"\xdd" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db2.import_interchange(payload, b"\x00" * 32)
+
+
+def _vc_setup():
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+    from lighthouse_tpu.state_transition.genesis import interop_secret_key
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    genesis_root = hdr.tree_hash_root()
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=genesis_root,
+                        preset=h.preset, spec=h.spec, T=h.T)
+    store = ValidatorStore()
+    for i in range(16):
+        store.add_validator(interop_secret_key(i), index=i)
+    return h, chain, store
+
+
+def test_validator_client_proposes_and_attests():
+    B.set_backend("fake")
+    try:
+        h, chain, store = _vc_setup()
+        bn = InProcessBeaconNode(chain)
+        vc = ValidatorClient(store, [bn], h.preset)
+        for slot in range(1, 5):
+            chain.per_slot_task(slot)
+            vc.on_slot(slot)
+            assert chain.head.slot == slot, f"no block at slot {slot}"
+        # Attestations flowed into the op pool.
+        assert chain.op_pool.num_attestations() > 0
+        # Slashing DB recorded our proposals: re-signing elsewhere fails.
+        pk = next(iter(store.keys))
+        idx = store.index_by_pubkey[pk]
+        duties = [d for e in vc.duties.proposers.values() for d in e
+                  if d.validator_index == idx]
+        if duties:
+            with pytest.raises(SlashingProtectionError):
+                store.slashing_db.check_and_insert_block_proposal(
+                    pk, duties[0].slot, b"\xff" * 32)
+    finally:
+        B.set_backend("python")
+
+
+def test_doppelganger_blocks_until_clear():
+    B.set_backend("fake")
+    try:
+        h, chain, store = _vc_setup()
+        bn = InProcessBeaconNode(chain)
+        vc = ValidatorClient(store, [bn], h.preset, doppelganger=True)
+        # While watching, nothing signs → no blocks land.
+        chain.per_slot_task(1)
+        vc.on_slot(1)
+        assert chain.head.slot == 0
+        # After the watch window with no detections, signing resumes.
+        for epoch in range(0, 4):
+            vc.doppelganger.check_epoch(epoch)
+        assert not store.doppelganger_blocked
+        chain.per_slot_task(2)
+        vc.on_slot(2)
+        assert chain.head.slot == 2
+    finally:
+        B.set_backend("python")
