@@ -1,0 +1,6 @@
+"""HTTP APIs: Beacon-API server + Prometheus metrics endpoint
+(counterparts of ``beacon_node/http_api`` and ``beacon_node/http_metrics``)."""
+
+from .http_api import HttpApiServer
+
+__all__ = ["HttpApiServer"]

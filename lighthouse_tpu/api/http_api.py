@@ -1,0 +1,196 @@
+"""Beacon-API HTTP server — ``beacon_node/http_api``
+(``/root/reference/beacon_node/http_api/src/lib.rs``) plus the Prometheus
+scrape endpoint of ``beacon_node/http_metrics``.
+
+A threaded stdlib HTTP server exposing the standard ``/eth/v1`` surface
+over an in-process :class:`~lighthouse_tpu.beacon_chain.BeaconChain` (the
+reference uses warp; the route table and JSON conventions are the spec's).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+from ..common.metrics import REGISTRY
+from ..ssz.json import to_json
+
+
+class HttpApiServer:
+    def __init__(self, chain, host: str = "127.0.0.1", port: int = 0):
+        self.chain = chain
+        self.requests_total = REGISTRY.counter(
+            "http_api_requests_total", "Beacon-API requests served")
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _text(self, text, code=200):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                api.requests_total.inc()
+                try:
+                    api._route_get(self)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"code": 500, "message": str(e)}, 500)
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length) if length else b""
+                    api._route_post(self, body)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"code": 500, "message": str(e)}, 500)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    # -- state resolution ----------------------------------------------------
+
+    def _state(self, state_id: str):
+        chain = self.chain
+        if state_id in ("head", "justified", "finalized"):
+            return chain.head.state
+        if state_id.startswith("0x"):
+            return chain.store.get_state(bytes.fromhex(state_id[2:]))
+        raise ValueError(f"unsupported state id {state_id}")
+
+    def _block(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            return chain.store.get_block(chain.head.root), chain.head.root
+        if block_id.startswith("0x"):
+            root = bytes.fromhex(block_id[2:])
+            return chain.store.get_block(root), root
+        raise ValueError(f"unsupported block id {block_id}")
+
+    # -- routes --------------------------------------------------------------
+
+    def _route_get(self, h) -> None:
+        path = urlparse(h.path).path.rstrip("/")
+        chain = self.chain
+        if path == "/eth/v1/node/version":
+            h._json({"data": {"version": "lighthouse-tpu/0.3.0"}})
+        elif path == "/eth/v1/node/health":
+            h.send_response(200)
+            h.end_headers()
+        elif path == "/eth/v1/node/syncing":
+            h._json({"data": {
+                "head_slot": str(chain.head.slot),
+                "sync_distance": str(max(
+                    chain.current_slot() - chain.head.slot, 0)),
+                "is_syncing": chain.current_slot() - chain.head.slot > 1,
+                "is_optimistic": False, "el_offline": False}})
+        elif path == "/eth/v1/beacon/genesis":
+            st = chain.head.state
+            h._json({"data": {
+                "genesis_time": str(int(st.genesis_time)),
+                "genesis_validators_root":
+                    "0x" + bytes(st.genesis_validators_root).hex(),
+                "genesis_fork_version":
+                    "0x" + bytes(st.fork.previous_version).hex()}})
+        elif path.startswith("/eth/v1/beacon/states/"):
+            parts = path.split("/")
+            state = self._state(parts[5])
+            if state is None:
+                h._json({"code": 404, "message": "state not found"}, 404)
+            elif parts[6] == "root":
+                h._json({"data": {
+                    "root": "0x" + state.tree_hash_root().hex()}})
+            elif parts[6] == "finality_checkpoints":
+                h._json({"data": {
+                    "previous_justified": to_json(
+                        state.previous_justified_checkpoint),
+                    "current_justified": to_json(
+                        state.current_justified_checkpoint),
+                    "finalized": to_json(state.finalized_checkpoint)}})
+            elif parts[6] == "validators":
+                reg = state.validators
+                out = []
+                for i in range(len(reg)):
+                    out.append({
+                        "index": str(i), "balance": str(int(state.balances[i])),
+                        "status": "active_ongoing",
+                        "validator": to_json(reg[i])})
+                h._json({"data": out})
+            else:
+                h._json({"code": 404, "message": "unknown route"}, 404)
+        elif path.startswith("/eth/v2/beacon/blocks/") \
+                or path.startswith("/eth/v1/beacon/headers/"):
+            block_id = path.split("/")[-1]
+            block, root = self._block(block_id)
+            if block is None:
+                h._json({"code": 404, "message": "block not found"}, 404)
+            elif "/headers/" in path:
+                msg = block.message
+                h._json({"data": {
+                    "root": "0x" + root.hex(), "canonical": True,
+                    "header": {"message": {
+                        "slot": str(int(msg.slot)),
+                        "proposer_index": str(int(msg.proposer_index)),
+                        "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                        "state_root": "0x" + bytes(msg.state_root).hex(),
+                        "body_root":
+                            "0x" + msg.body.tree_hash_root().hex()},
+                        "signature": "0x" + bytes(block.signature).hex()}}})
+            else:
+                h._json({"version": "capella", "data": to_json(block)})
+        elif path == "/eth/v1/beacon/pool/attestations":
+            atts = []
+            for entry in chain.op_pool.attestations.values():
+                for stored in entry:
+                    atts.append(to_json(
+                        chain.op_pool._to_attestation(stored, chain.T)))
+            h._json({"data": atts})
+        elif path == "/metrics":
+            h._text(REGISTRY.encode())
+        elif path.startswith("/lighthouse/health"):
+            h._json({"data": {"observed_attesters": "ok"}})
+        else:
+            h._json({"code": 404, "message": "unknown route"}, 404)
+
+    def _route_post(self, h, body: bytes) -> None:
+        path = urlparse(h.path).path.rstrip("/")
+        chain = self.chain
+        if path == "/eth/v1/beacon/blocks":
+            # SSZ-encoded signed block publish (broadcast-then-import,
+            # `publish_blocks.rs`).
+            fork = chain.spec.fork_name_at_epoch(
+                chain.current_slot() // chain.preset.SLOTS_PER_EPOCH)
+            signed = chain.T.signed_block_cls(fork).deserialize(body)
+            chain.per_slot_task(int(signed.message.slot))
+            chain.process_block(signed, is_timely=True)
+            h._json({})
+        else:
+            h._json({"code": 404, "message": "unknown route"}, 404)

@@ -1,0 +1,239 @@
+"""The ``lighthouse-tpu`` command-line tool.
+
+One binary, subcommands — mirroring the reference's CLI tree
+(``/root/reference/lighthouse/src/main.rs:315-319``: ``beacon_node``,
+``validator_client``, ``account_manager``, ``database_manager``) plus the
+``lcli`` developer tools (``transition-blocks``/``skip-slots`` per-phase
+profilers, ``lcli/src/transition_blocks.rs:229,308-396``).
+
+Run as ``python -m lighthouse_tpu.cli <subcommand> ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", choices=["minimal", "mainnet"],
+                   default="minimal")
+    p.add_argument("--validators", type=int, default=64)
+
+
+def _setup(args):
+    from .crypto import bls
+    from .testing.harness import StateHarness
+    from .types.presets import MAINNET, MINIMAL
+
+    bls.set_backend(args.backend if hasattr(args, "backend") else "fake")
+    preset = MINIMAL if args.preset == "minimal" else MAINNET
+    return StateHarness(n_validators=args.validators, preset=preset)
+
+
+def cmd_transition_blocks(args) -> int:
+    """Per-phase block-application profiler (`lcli transition-blocks`)."""
+    from .state_transition import SignatureStrategy
+    from .state_transition.per_block import process_block
+    from .state_transition.per_slot import process_slots
+
+    h = _setup(args)
+    h.extend_chain(args.warmup_blocks)
+    signed = h.build_block()
+    pre_state = h.state
+    fork = h.fork_at(int(signed.message.slot))
+    strategy = (SignatureStrategy.VERIFY_BULK if args.backend != "fake"
+                else SignatureStrategy.NO_VERIFICATION)
+
+    phases = {"slot_advance": [], "block_processing": [], "state_root": []}
+    for _ in range(args.runs):
+        state = pre_state.copy()
+        t0 = time.perf_counter()
+        state = process_slots(state, int(signed.message.slot), h.preset,
+                              h.spec, h.T)
+        t1 = time.perf_counter()
+        process_block(state, signed, fork, h.preset, h.spec, h.T,
+                      strategy=strategy)
+        t2 = time.perf_counter()
+        state.tree_hash_root()
+        t3 = time.perf_counter()
+        phases["slot_advance"].append((t1 - t0) * 1e3)
+        phases["block_processing"].append((t2 - t1) * 1e3)
+        phases["state_root"].append((t3 - t2) * 1e3)
+
+    out = {name: {"min_ms": round(min(v), 3),
+                  "mean_ms": round(sum(v) / len(v), 3)}
+           for name, v in phases.items()}
+    out["runs"] = args.runs
+    out["attestations_in_block"] = len(signed.message.body.attestations)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_skip_slots(args) -> int:
+    """`lcli skip-slots`: cost of empty-slot advance (epoch boundaries)."""
+    from .state_transition.per_slot import process_slots
+
+    h = _setup(args)
+    state = h.state
+    t0 = time.perf_counter()
+    process_slots(state.copy(), int(state.slot) + args.slots, h.preset,
+                  h.spec, h.T)
+    dt = (time.perf_counter() - t0) * 1e3
+    print(json.dumps({"slots": args.slots, "total_ms": round(dt, 3),
+                      "ms_per_slot": round(dt / args.slots, 3)}))
+    return 0
+
+
+def cmd_beacon_node(args) -> int:
+    """Run an interop beacon node + HTTP API (demo/devnet mode)."""
+    from .api import HttpApiServer
+    from .beacon_chain import BeaconChain
+    from .common.slot_clock import SystemTimeSlotClock
+    from .store import HotColdDB, SqliteStore
+    from .validator_client import (
+        InProcessBeaconNode, ValidatorClient, ValidatorStore)
+    from .state_transition.genesis import interop_secret_key
+
+    h = _setup(args)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    store = (HotColdDB(SqliteStore(args.datadir + "/beacon.sqlite"),
+                       h.preset, h.spec, h.T) if args.datadir
+             else HotColdDB.memory(h.preset, h.spec, h.T))
+    chain = BeaconChain(store=store, genesis_state=h.state.copy(),
+                        genesis_block_root=hdr.tree_hash_root(),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    api = HttpApiServer(chain, port=args.http_port)
+    api.start()
+    print(f"beacon node up: http://127.0.0.1:{api.port} "
+          f"(validators={args.validators}, preset={args.preset})")
+    vc = None
+    if args.with_validators:
+        vstore = ValidatorStore()
+        for i in range(args.validators):
+            vstore.add_validator(interop_secret_key(i), index=i)
+        vc = ValidatorClient(vstore, [InProcessBeaconNode(chain)], h.preset)
+    clock = SystemTimeSlotClock(genesis_time=int(time.time()),
+                                seconds_per_slot=args.seconds_per_slot)
+    last = 0
+    try:
+        deadline = (time.time() + args.run_for) if args.run_for else None
+        while deadline is None or time.time() < deadline:
+            slot = clock.now()
+            if slot > last:
+                last = slot
+                chain.per_slot_task(slot)
+                if vc is not None:
+                    vc.on_slot(slot)
+                print(f"slot {slot} head={chain.head.root.hex()[:12]} "
+                      f"(slot {chain.head.slot})")
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        pass
+    api.stop()
+    return 0
+
+
+def cmd_account(args) -> int:
+    """`account_manager`: create/import EIP-2335 keystores."""
+    import getpass
+    import os
+    import secrets as pysecrets
+
+    from .crypto import bls
+    from .crypto.key_derivation import derive_path, validator_signing_path
+    from .crypto.keystore import Keystore
+
+    os.makedirs(args.dir, exist_ok=True)
+    if args.account_cmd == "create":
+        password = args.password or getpass.getpass("keystore password: ")
+        seed = pysecrets.token_bytes(32)
+        for i in range(args.count):
+            sk_int = derive_path(seed, validator_signing_path(i))
+            sk = bls.SecretKey(sk_int)
+            ks = Keystore.encrypt(
+                sk.serialize(), password,
+                pubkey=sk.public_key().serialize(),
+                path=validator_signing_path(i), scrypt_n=args.scrypt_n)
+            out = os.path.join(args.dir, f"keystore-{i}.json")
+            with open(out, "w") as f:
+                f.write(ks.to_json())
+            print(f"wrote {out} pubkey=0x{ks.pubkey[:16]}…")
+        return 0
+    if args.account_cmd == "list":
+        for name in sorted(os.listdir(args.dir)):
+            if name.endswith(".json"):
+                with open(os.path.join(args.dir, name)) as f:
+                    ks = Keystore.from_json(f.read())
+                print(f"{name}: 0x{ks.pubkey} path={ks.path}")
+        return 0
+    print("unknown account command", file=sys.stderr)
+    return 1
+
+
+def cmd_db(args) -> int:
+    """`database_manager`: inspect a store."""
+    from .store import DBColumn, SqliteStore
+
+    kv = SqliteStore(args.path)
+    out = {}
+    for col in DBColumn:
+        n = sum(1 for _ in kv.iter_column(col))
+        if n:
+            out[col.name] = n
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="lighthouse-tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node (interop/devnet)")
+    _add_common(bn)
+    bn.add_argument("--backend", default="fake",
+                    choices=["fake", "python", "tpu"])
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--seconds-per-slot", type=int, default=2)
+    bn.add_argument("--with-validators", action="store_true")
+    bn.add_argument("--datadir", default="")
+    bn.add_argument("--run-for", type=float, default=0,
+                    help="seconds to run (0 = forever)")
+    bn.set_defaults(fn=cmd_beacon_node)
+
+    tb = sub.add_parser("transition-blocks",
+                        help="per-phase block application profiler")
+    _add_common(tb)
+    tb.add_argument("--backend", default="fake",
+                    choices=["fake", "python", "tpu"])
+    tb.add_argument("--runs", type=int, default=5)
+    tb.add_argument("--warmup-blocks", type=int, default=2)
+    tb.set_defaults(fn=cmd_transition_blocks)
+
+    ss = sub.add_parser("skip-slots", help="empty slot advance profiler")
+    _add_common(ss)
+    ss.add_argument("--backend", default="fake")
+    ss.add_argument("--slots", type=int, default=8)
+    ss.set_defaults(fn=cmd_skip_slots)
+
+    ac = sub.add_parser("account", help="keystore management")
+    ac.add_argument("account_cmd", choices=["create", "list"])
+    ac.add_argument("--dir", default="validator_keys")
+    ac.add_argument("--count", type=int, default=1)
+    ac.add_argument("--password", default="")
+    ac.add_argument("--scrypt-n", type=int, default=16384)
+    ac.set_defaults(fn=cmd_account)
+
+    db = sub.add_parser("db", help="database inspection")
+    db.add_argument("path")
+    db.set_defaults(fn=cmd_db)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

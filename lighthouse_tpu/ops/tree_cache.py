@@ -76,7 +76,9 @@ class IncrementalMerkleCache:
             while dev.shape[0] > 1:
                 HASH_COUNT[0] += dev.shape[0] // 2
                 dev = hash64(dev[0::2], dev[1::2])
-                levels.append(np.asarray(dev))
+                # np.array: device pulls are read-only views; levels must
+                # stay writable for later dirty-path updates.
+                levels.append(np.array(dev))
         else:
             while cur.shape[0] > 1:
                 cur = _h64_host(cur[0::2], cur[1::2])
@@ -94,7 +96,7 @@ class IncrementalMerkleCache:
             if big:
                 import jax.numpy as jnp
                 HASH_COUNT[0] += idx.size
-                out = np.asarray(hash64(jnp.asarray(left), jnp.asarray(right)))
+                out = np.array(hash64(jnp.asarray(left), jnp.asarray(right)))
             else:
                 out = _h64_host(left, right)
             self.levels[lvl][idx] = out

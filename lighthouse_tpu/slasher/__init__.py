@@ -1,0 +1,200 @@
+"""Slasher: attester/proposer slashing detection —
+``slasher`` (``/root/reference/slasher/src/``).
+
+The reference implements the Phase-0 "minimal span" design as chunked
+min/max-target arrays in LMDB/MDBX, updated per validator-chunk×epoch-chunk
+grid (``array.rs:106-116,486,573``).  Columnar redesign: the WHOLE span
+plane is two numpy arrays (validators × history window) and every ingest is
+a broadcast range-min/max over the epoch axis — the per-chunk loops become
+single vector ops (and, at registry scale, a device dispatch).
+
+Detection rules (``lib.rs:33-49`` AttesterSlashingStatus):
+
+- double vote: same (validator, target epoch), different attestation data;
+- surround: ``max_span[v][s] > t − s`` ⇒ an earlier attestation surrounds
+  the new one; ``min_span[v][s] < t − s`` ⇒ the new one surrounds an
+  earlier one.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..store.kv import DBColumn, KeyValueStore, MemoryStore
+
+_NO_SPAN_MIN = np.uint16(0xFFFF)
+_NO_SPAN_MAX = np.uint16(0)
+
+
+@dataclass
+class AttesterRecord:
+    """Indexed attestation summary kept for slashing construction."""
+    source: int
+    target: int
+    data_root: bytes
+    indexed: object  # the original IndexedAttestation-like object
+
+
+@dataclass
+class Slashing:
+    """A detected offence: the two conflicting attestations."""
+    kind: str  # "double" | "surrounds" | "surrounded"
+    validator_index: int
+    attestation_1: object
+    attestation_2: object
+
+
+class Slasher:
+    """Whole-plane min/max-span slasher."""
+
+    def __init__(self, n_validators: int, history_length: int = 4096,
+                 kv: Optional[KeyValueStore] = None):
+        self.history = history_length
+        self.n = n_validators
+        # Spans store (target − e) distances, clamped to u16 like the
+        # reference chunks (`array.rs` MIN_SPAN/MAX_SPAN encodings).
+        self.min_span = np.full((n_validators, history_length), _NO_SPAN_MIN,
+                                np.uint16)
+        self.max_span = np.full((n_validators, history_length), _NO_SPAN_MAX,
+                                np.uint16)
+        # (validator, target) → AttesterRecord for double votes + evidence.
+        self.by_target: Dict[Tuple[int, int], AttesterRecord] = {}
+        self.kv = kv or MemoryStore()
+        self.queue: List[object] = []
+
+    # -- ingest --------------------------------------------------------------
+
+    def accept_attestation(self, indexed) -> None:
+        """Batch ingest queue (`attestation_queue.rs`)."""
+        self.queue.append(indexed)
+
+    def process_queued(self, current_epoch: int) -> List[Slashing]:
+        """Drain the queue — one vectorized span update per attestation
+        (the reference's per-chunk batch `update()` grid)."""
+        out: List[Slashing] = []
+        for indexed in self.queue:
+            out.extend(self._process_one(indexed, current_epoch))
+        self.queue = []
+        return out
+
+    def _process_one(self, indexed, current_epoch: int) -> List[Slashing]:
+        data = indexed.data
+        s = int(data.source.epoch)
+        t = int(data.target.epoch)
+        if t < s or t > current_epoch or current_epoch - t >= self.history:
+            return []
+        data_root = data.tree_hash_root()
+        idx = np.asarray([int(i) for i in indexed.attesting_indices],
+                         dtype=np.int64)
+        idx = idx[idx < self.n]
+        out: List[Slashing] = []
+
+        # Double votes (per validator; dict lookups, small).
+        live = []
+        for v in idx:
+            rec = self.by_target.get((int(v), t))
+            if rec is not None and rec.data_root != data_root:
+                out.append(Slashing("double", int(v), rec.indexed, indexed))
+            else:
+                live.append(int(v))
+        live = np.asarray(live, dtype=np.int64)
+        if live.size == 0:
+            return out
+
+        dist = t - s
+        se = s % self.history
+        # Surround checks — one gather per plane (`array.rs` chunk reads).
+        surrounds = self.max_span[live, se].astype(np.int64) > dist
+        surrounded = self.min_span[live, se].astype(np.int64) < dist
+        for v in live[surrounds]:
+            prior = self._find_surrounding(int(v), s, t)
+            if prior is not None:
+                out.append(Slashing("surrounds", int(v), prior.indexed,
+                                    indexed))
+        for v in live[surrounded]:
+            prior = self._find_surrounded(int(v), s, t)
+            if prior is not None:
+                out.append(Slashing("surrounded", int(v), indexed,
+                                    prior.indexed))
+
+        # Span plane updates — broadcast range ops over the epoch axis
+        # (`array.rs:486,573` update_* loops as single vector ops):
+        # min_span[v][e] = min(., t−e) for e in [t−history+1, s);
+        # max_span[v][e] = max(., t−e) for e in (s, t).
+        lo = max(s - self.history + 1, 0)
+        if s > lo:
+            es = np.arange(lo, s)
+            cols = es % self.history
+            vals = np.minimum(t - es, 0xFFFE).astype(np.uint16)
+            plane = self.min_span[live[:, None], cols[None, :]]
+            self.min_span[live[:, None], cols[None, :]] = \
+                np.minimum(plane, vals[None, :])
+        if t > s + 1:
+            es = np.arange(s + 1, t)
+            cols = es % self.history
+            vals = (t - es).astype(np.uint16)
+            plane = self.max_span[live[:, None], cols[None, :]]
+            self.max_span[live[:, None], cols[None, :]] = \
+                np.maximum(plane, vals[None, :])
+
+        rec = AttesterRecord(s, t, data_root, indexed)
+        for v in live:
+            self.by_target[(int(v), t)] = rec
+        return out
+
+    def _find_surrounding(self, v: int, s: int, t: int):
+        """Locate an attestation (s' < s, t' > t) for evidence."""
+        best = None
+        for (vi, target), rec in self.by_target.items():
+            if vi == v and rec.source < s and target > t:
+                if best is None or target < best.target:
+                    best = rec
+        return best
+
+    def _find_surrounded(self, v: int, s: int, t: int):
+        best = None
+        for (vi, target), rec in self.by_target.items():
+            if vi == v and rec.source > s and target < t:
+                if best is None or target > best.target:
+                    best = rec
+        return best
+
+    # -- blocks (proposer equivocation) --------------------------------------
+
+    def accept_block_header(self, signed_header) -> Optional[Slashing]:
+        """`block_queue.rs` + proposer double-proposal detection."""
+        h = signed_header.message
+        key = struct.pack("<QQ", int(h.proposer_index), int(h.slot))
+        root = h.tree_hash_root()
+        prev = self.kv.get(DBColumn.BeaconMeta, b"hdr" + key)
+        if prev is None:
+            self.kv.put(DBColumn.BeaconMeta, b"hdr" + key,
+                        root + signed_header.encode())
+            return None
+        if prev[:32] == root:
+            return None
+        return Slashing("double_proposal", int(h.proposer_index),
+                        prev[32:], signed_header)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def grow(self, n_validators: int) -> None:
+        if n_validators <= self.n:
+            return
+        extra = n_validators - self.n
+        self.min_span = np.concatenate(
+            [self.min_span, np.full((extra, self.history), _NO_SPAN_MIN,
+                                    np.uint16)])
+        self.max_span = np.concatenate(
+            [self.max_span, np.full((extra, self.history), _NO_SPAN_MAX,
+                                    np.uint16)])
+        self.n = n_validators
+
+    def prune(self, current_epoch: int) -> None:
+        horizon = current_epoch - self.history
+        self.by_target = {k: v for k, v in self.by_target.items()
+                          if k[1] > horizon}

@@ -1,0 +1,40 @@
+"""Spec-JSON encoding of SSZ values — the ``serde_utils`` role
+(``/root/reference/consensus/serde_utils/src/``): byte fields as 0x-hex,
+every uint as a decimal string, containers as objects, lists as arrays —
+the Beacon-API wire convention."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .composite import Container
+
+
+def to_json(value: Any) -> Any:
+    """SSZ value → JSON-compatible structure (spec conventions)."""
+    if isinstance(value, Container):
+        return {name: to_json(getattr(value, name))
+                for name in type(value).FIELDS}
+    if isinstance(value, (bytes, bytearray)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (bool, np.bool_)):
+        return bool(value)
+    if isinstance(value, (int, np.integer)):
+        return str(int(value))
+    if isinstance(value, np.ndarray):
+        if value.dtype == np.uint8 and value.ndim == 2:
+            return ["0x" + row.tobytes().hex() for row in value]
+        return [to_json(v) for v in value.tolist()]
+    if isinstance(value, (list, tuple)):
+        return [to_json(v) for v in value]
+    if hasattr(value, "__iter__"):
+        return [to_json(v) for v in value]
+    return value
+
+
+def hex_bytes(data: str) -> bytes:
+    if not data.startswith("0x"):
+        raise ValueError("expected 0x-prefixed hex")
+    return bytes.fromhex(data[2:])

@@ -47,8 +47,9 @@ class RegistryCache:
             self.tree = IncrementalMerkleCache(limit, mixin_length=True)
         if self.stored is None or self.record_roots is None \
                 or self.record_roots.shape[0] > n:
-            # Cold start (or shrink, which consensus never does): full build.
-            self.record_roots = reg.record_roots_words()
+            # Cold start (or shrink, which consensus never does): full
+            # build.  np.array: the device path hands back read-only views.
+            self.record_roots = np.array(reg.record_roots_words())
             self.stored = {c: np.array(getattr(reg, c)[:n])
                            for c in reg._COLUMNS}
         else:

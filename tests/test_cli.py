@@ -1,0 +1,43 @@
+"""CLI subcommands (the lighthouse binary + lcli tree)."""
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu.cli import main
+
+
+def test_transition_blocks_profiler(capsys):
+    assert main(["transition-blocks", "--runs", "2",
+                 "--warmup-blocks", "1", "--validators", "16"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out) >= {"slot_advance", "block_processing", "state_root"}
+    assert out["runs"] == 2
+
+
+def test_skip_slots_profiler(capsys):
+    assert main(["skip-slots", "--slots", "4", "--validators", "16"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["slots"] == 4 and out["total_ms"] > 0
+
+
+def test_account_create_and_list(tmp_path, capsys):
+    d = os.path.join(tmp_path, "keys")
+    assert main(["account", "create", "--dir", d, "--count", "2",
+                 "--password", "pw", "--scrypt-n", "2048"]) == 0
+    assert main(["account", "list", "--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "keystore-0.json" in out and "keystore-1.json" in out
+
+
+def test_bn_runs_briefly_and_db_inspect(tmp_path, capsys):
+    datadir = str(tmp_path)
+    assert main(["bn", "--validators", "16", "--http-port", "0",
+                 "--seconds-per-slot", "1", "--with-validators",
+                 "--datadir", datadir, "--run-for", "2.5"]) == 0
+    out = capsys.readouterr().out
+    assert "beacon node up" in out
+    assert main(["db", os.path.join(datadir, "beacon.sqlite")]) == 0
+    cols = json.loads(capsys.readouterr().out)
+    assert cols.get("BeaconMeta", 0) >= 1

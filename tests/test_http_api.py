@@ -1,0 +1,89 @@
+"""Beacon-API server over an in-process chain (mirrors `http_api/tests`)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.api import HttpApiServer
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture
+def api():
+    B.set_backend("fake")
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=hdr.tree_hash_root(),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    srv = HttpApiServer(chain)
+    srv.start()
+    yield h, chain, srv
+    srv.stop()
+    B.set_backend("python")
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}") as r:
+        ct = r.headers.get("Content-Type", "")
+        body = r.read()
+        return json.loads(body) if "json" in ct else body.decode()
+
+
+def test_node_and_genesis_endpoints(api):
+    h, chain, srv = api
+    v = _get(srv, "/eth/v1/node/version")
+    assert v["data"]["version"].startswith("lighthouse-tpu/")
+    g = _get(srv, "/eth/v1/beacon/genesis")
+    assert g["data"]["genesis_validators_root"] == \
+        "0x" + bytes(h.state.genesis_validators_root).hex()
+    s = _get(srv, "/eth/v1/node/syncing")
+    assert s["data"]["head_slot"] == "0"
+
+
+def test_block_publish_and_queries(api):
+    h, chain, srv = api
+    signed = h.build_block()
+    h.apply_block(signed)
+    # POST the SSZ block through the publish endpoint.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/eth/v1/beacon/blocks",
+        data=signed.encode(), method="POST")
+    with urllib.request.urlopen(req) as r:
+        assert r.status == 200
+    assert chain.head.slot == 1
+
+    hd = _get(srv, "/eth/v1/beacon/headers/head")
+    assert hd["data"]["header"]["message"]["slot"] == "1"
+    blk = _get(srv, "/eth/v2/beacon/blocks/head")
+    assert blk["data"]["message"]["slot"] == "1"
+    root = _get(srv, "/eth/v1/beacon/states/head/root")
+    assert root["data"]["root"] == "0x" + h.state.tree_hash_root().hex()
+    vals = _get(srv, "/eth/v1/beacon/states/head/validators")
+    assert len(vals["data"]) == 16
+    assert vals["data"][3]["validator"]["pubkey"].startswith("0x")
+    fc = _get(srv, "/eth/v1/beacon/states/head/finality_checkpoints")
+    assert "finalized" in fc["data"]
+
+
+def test_metrics_endpoint(api):
+    h, chain, srv = api
+    text = _get(srv, "/metrics")
+    assert "# TYPE" in text
+
+
+def test_unknown_routes_404(api):
+    h, chain, srv = api
+    try:
+        _get(srv, "/eth/v1/unknown")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

@@ -1,0 +1,104 @@
+"""Slasher: double votes, surround votes (both directions), span planes.
+
+Mirrors the reference's `slasher/tests` attester-slashing scenarios over
+the vectorized span arrays.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.slasher import Slasher
+from lighthouse_tpu.types.factory import spec_types
+from lighthouse_tpu.types.presets import MINIMAL
+
+T = spec_types(MINIMAL)
+
+
+class Indexed:
+    def __init__(self, indices, source, target, root=b"\x01" * 32):
+        self.attesting_indices = indices
+        self.data = T.AttestationData(
+            slot=target * 8, index=0, beacon_block_root=root,
+            source=T.Checkpoint(epoch=source, root=b"\x00" * 32),
+            target=T.Checkpoint(epoch=target, root=root))
+
+
+def drain(s, *atts, epoch=100):
+    for a in atts:
+        s.accept_attestation(a)
+    return s.process_queued(epoch)
+
+
+def test_benign_attestations_no_slashing():
+    s = Slasher(n_validators=16)
+    out = drain(s, Indexed([1, 2], 1, 2), Indexed([1, 2], 2, 3),
+                Indexed([1], 3, 4))
+    assert out == []
+
+
+def test_double_vote_detected():
+    s = Slasher(n_validators=16)
+    a1 = Indexed([3], 1, 5, root=b"\x0a" * 32)
+    a2 = Indexed([3], 1, 5, root=b"\x0b" * 32)
+    out = drain(s, a1, a2)
+    assert len(out) == 1
+    assert out[0].kind == "double" and out[0].validator_index == 3
+    # Re-reporting the identical attestation is not a double vote.
+    assert drain(s, Indexed([3], 1, 5, root=b"\x0a" * 32)) == []
+
+
+def test_existing_surrounds_new():
+    s = Slasher(n_validators=16)
+    big = Indexed([7], 1, 10)
+    small = Indexed([7], 3, 5)  # surrounded by (1, 10)
+    assert drain(s, big) == []
+    out = drain(s, small)
+    assert len(out) == 1 and out[0].kind == "surrounds"
+    assert out[0].attestation_1 is big and out[0].attestation_2 is small
+
+
+def test_new_surrounds_existing():
+    s = Slasher(n_validators=16)
+    small = Indexed([7], 3, 5)
+    big = Indexed([7], 1, 10)  # surrounds (3, 5)
+    assert drain(s, small) == []
+    out = drain(s, big)
+    assert len(out) == 1 and out[0].kind == "surrounded"
+    assert out[0].attestation_1 is big and out[0].attestation_2 is small
+
+
+def test_batch_multiple_validators_vectorized():
+    s = Slasher(n_validators=64)
+    assert drain(s, Indexed(list(range(32)), 2, 8)) == []
+    out = drain(s, Indexed(list(range(16)), 3, 6))
+    # All 16 overlapping validators slashed at once (surrounded by 2→8).
+    assert len(out) == 16
+    assert {o.validator_index for o in out} == set(range(16))
+
+
+def test_grow_and_out_of_range_ignored():
+    s = Slasher(n_validators=4, history_length=64)
+    # Validator index beyond n is ignored, not crashing.
+    assert drain(s, Indexed([100], 1, 2)) == []
+    s.grow(128)
+    assert drain(s, Indexed([100], 2, 3)) == []
+    # Targets older than the history window are ignored.
+    assert drain(s, Indexed([1], 1, 2), epoch=1000) == []
+
+
+def test_proposer_double_proposal():
+    s = Slasher(n_validators=8)
+    h1 = T.BeaconBlockHeader(slot=5, proposer_index=2,
+                             parent_root=b"\x01" * 32,
+                             state_root=b"\x02" * 32,
+                             body_root=b"\x03" * 32)
+    h2 = T.BeaconBlockHeader(slot=5, proposer_index=2,
+                             parent_root=b"\x01" * 32,
+                             state_root=b"\x04" * 32,
+                             body_root=b"\x03" * 32)
+    s1 = T.SignedBeaconBlockHeader(message=h1, signature=b"\xc0" + b"\x00" * 95)
+    s2 = T.SignedBeaconBlockHeader(message=h2, signature=b"\xc0" + b"\x00" * 95)
+    assert s.accept_block_header(s1) is None
+    assert s.accept_block_header(s1) is None  # identical: benign
+    out = s.accept_block_header(s2)
+    assert out is not None and out.kind == "double_proposal"
