@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import faulthandler
 import json
+import os
 import signal
 import sys
 import time
@@ -170,6 +171,15 @@ def _incremental_state_root_bench() -> dict:
 
 
 def main() -> None:
+    # Persistent compilation cache: axon remote compiles are slow and
+    # occasionally hang; once a kernel compiles successfully the cache
+    # makes every later run (including the driver's) hit disk instead.
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
     bls = _bls_bench()
     reg = _registry_htr_bench()
     inc = _incremental_state_root_bench()

@@ -48,9 +48,6 @@ def interop_genesis_state(n_validators: int, genesis_time: int, preset, spec,
     construct the registry directly, like ``interop.rs`` fast-path)."""
     from .per_epoch import get_next_sync_committee
 
-    if fork == ForkName.PHASE0:
-        raise NotImplementedError("start chains at altair or later")
-
     reg = ValidatorRegistry(n_validators)
     reg._n = n_validators
     pubs = np.zeros((n_validators, 48), dtype=np.uint8)
@@ -96,12 +93,15 @@ def interop_genesis_state(n_validators: int, genesis_time: int, preset, spec,
     state.genesis_validators_root = type(state).FIELDS[
         "validators"].hash_tree_root(reg)
 
-    state.previous_epoch_participation = np.zeros(n_validators, dtype=np.uint8)
-    state.current_epoch_participation = np.zeros(n_validators, dtype=np.uint8)
-    state.inactivity_scores = np.zeros(n_validators, dtype=np.uint64)
-    sync = get_next_sync_committee(state, preset, T)
-    state.current_sync_committee = sync
-    state.next_sync_committee = get_next_sync_committee(state, preset, T)
+    if fork >= ForkName.ALTAIR:
+        state.previous_epoch_participation = np.zeros(n_validators,
+                                                      dtype=np.uint8)
+        state.current_epoch_participation = np.zeros(n_validators,
+                                                     dtype=np.uint8)
+        state.inactivity_scores = np.zeros(n_validators, dtype=np.uint64)
+        sync = get_next_sync_committee(state, preset, T)
+        state.current_sync_committee = sync
+        state.next_sync_committee = get_next_sync_committee(state, preset, T)
 
     if fork >= ForkName.BELLATRIX:
         # Post-merge genesis: a synthetic terminal execution header so the

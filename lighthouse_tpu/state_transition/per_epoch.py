@@ -408,13 +408,31 @@ def process_sync_committee_updates(state, preset, T) -> None:
 # Entry point
 # ---------------------------------------------------------------------------
 
+def process_epoch_phase0(state, preset, spec, T) -> EpochSummary:
+    """Phase0 epoch transition (`per_epoch_processing/base/`): the
+    PendingAttestation-driven steps, then the shared tail."""
+    from . import per_epoch_phase0 as P0
+
+    summary = EpochSummary()
+    P0.process_justification_and_finalization_phase0(state, preset, T,
+                                                     summary)
+    P0.process_rewards_and_penalties_phase0(state, preset, spec, summary)
+    process_registry_updates(state, preset, spec, summary)
+    process_slashings(state, ForkName.PHASE0, preset)
+    process_eth1_data_reset(state, preset)
+    process_effective_balance_updates(state, preset)
+    process_slashings_reset(state, preset)
+    process_randao_mixes_reset(state, preset)
+    process_historical_update(state, ForkName.PHASE0, preset, T)
+    P0.process_participation_record_updates(state)
+    return summary
+
+
 def process_epoch(state, fork: ForkName, preset, spec, T) -> EpochSummary:
     """Altair+ epoch transition, step order per
     ``per_epoch_processing/altair.rs:process_epoch``."""
     if fork == ForkName.PHASE0:
-        raise NotImplementedError(
-            "phase0 (PendingAttestation-based) epoch processing is not "
-            "implemented; start chains at altair or later")
+        return process_epoch_phase0(state, preset, spec, T)
     summary = EpochSummary()
     process_justification_and_finalization(state, preset, T, summary)
     process_inactivity_updates(state, preset, spec)

@@ -1,0 +1,175 @@
+"""Phase0 (PendingAttestation-based) epoch processing —
+``per_epoch_processing/base``
+(``/root/reference/consensus/state_processing/src/per_epoch_processing/base/``).
+
+Pre-altair, participation is reconstructed each epoch from the stored
+``PendingAttestation`` lists: matching source/target/head sets resolve
+through historical committees, then the four base-reward components
+(source, target, head, inclusion delay) and the inactivity leak apply.
+Participation resolves into boolean masks over the whole registry so the
+reward math is column arithmetic like the altair path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.chain_spec import ForkName
+from .committees import get_beacon_committee
+from math import isqrt
+
+from .helpers import (
+    current_epoch,
+    get_block_root,
+    get_block_root_at_slot,
+    get_total_active_balance,
+    previous_epoch,
+)
+from .per_epoch import (
+    EpochSummary,
+    eligible_validator_mask,
+    weigh_justification_and_finalization,
+)
+
+BASE_REWARDS_PER_EPOCH = 4
+
+
+def _attestation_masks(state, attestations, preset):
+    """(source_mask, min_delay, min_proposer) over the registry for a
+    pending-attestation list: which unslashed validators attested, their
+    minimum inclusion delay and that attestation's proposer."""
+    n = len(state.validators)
+    mask = np.zeros(n, dtype=bool)
+    min_delay = np.full(n, np.iinfo(np.int64).max, dtype=np.int64)
+    min_proposer = np.full(n, -1, dtype=np.int64)
+    for att in attestations:
+        committee = np.asarray(get_beacon_committee(
+            state, int(att.data.slot), int(att.data.index), preset))
+        bits = np.asarray(att.aggregation_bits, dtype=bool)[:len(committee)]
+        idx = committee[bits]
+        mask[idx] = True
+        delay = int(att.inclusion_delay)
+        better = delay < min_delay[idx]
+        min_delay[idx[better]] = delay
+        min_proposer[idx[better]] = int(att.proposer_index)
+    mask &= ~np.asarray(state.validators.col("slashed"))
+    return mask, min_delay, min_proposer
+
+
+def _matching_attestations(state, epoch: int, preset):
+    cur = current_epoch(state, preset)
+    atts = (state.current_epoch_attestations if epoch == cur
+            else state.previous_epoch_attestations)
+    source = list(atts)
+    boundary = get_block_root(state, epoch, preset)
+    target = [a for a in source if bytes(a.data.target.root) == boundary]
+    head = [a for a in target
+            if bytes(a.data.beacon_block_root)
+            == get_block_root_at_slot(state, int(a.data.slot), preset)]
+    return source, target, head
+
+
+def _finality_delay(state, preset) -> int:
+    return previous_epoch(state, preset) - int(
+        state.finalized_checkpoint.epoch)
+
+
+def _in_leak(state, preset) -> bool:
+    return _finality_delay(state, preset) \
+        > preset.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def process_justification_and_finalization_phase0(
+        state, preset, T, summary: EpochSummary) -> None:
+    """Target balances from PendingAttestations (`base/justification...`)."""
+    from ..types.chain_spec import GENESIS_EPOCH
+
+    cur = current_epoch(state, preset)
+    if cur <= GENESIS_EPOCH + 1:
+        return
+    prev = previous_epoch(state, preset)
+    balances = state.validators.col("effective_balance")
+    _, prev_t, _ = _matching_attestations(state, prev, preset)
+    prev_mask, _, _ = _attestation_masks(state, prev_t, preset)
+    _, cur_t, _ = _matching_attestations(state, cur, preset)
+    cur_mask, _, _ = _attestation_masks(state, cur_t, preset)
+    total = get_total_active_balance(state, preset)
+    prev_bal = max(int(balances[prev_mask].sum()),
+                   preset.EFFECTIVE_BALANCE_INCREMENT)
+    cur_bal = max(int(balances[cur_mask].sum()),
+                  preset.EFFECTIVE_BALANCE_INCREMENT)
+    summary.total_active_balance = total
+    summary.previous_target_balance = prev_bal
+    summary.current_target_balance = cur_bal
+    weigh_justification_and_finalization(state, total, prev_bal, cur_bal,
+                                         preset, T)
+
+
+def process_rewards_and_penalties_phase0(state, preset, spec,
+                                         summary: EpochSummary) -> None:
+    """`get_attestation_deltas` (`base/rewards_and_penalties.rs`), as
+    column arithmetic over the participation masks."""
+    from ..types.chain_spec import GENESIS_EPOCH
+
+    if current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    balances = np.asarray(state.validators.col("effective_balance"),
+                          dtype=np.int64)
+    total = get_total_active_balance(state, preset)
+    sqrt_total = isqrt(total)
+    base_reward = (balances * preset.BASE_REWARD_FACTOR // sqrt_total
+                   // BASE_REWARDS_PER_EPOCH)
+    eligible = eligible_validator_mask(state, preset)
+    prev = previous_epoch(state, preset)
+    src_atts, tgt_atts, head_atts = _matching_attestations(
+        state, prev, preset)
+    src_mask, min_delay, min_prop = _attestation_masks(state, src_atts,
+                                                       preset)
+    tgt_mask, _, _ = _attestation_masks(state, tgt_atts, preset)
+    head_mask, _, _ = _attestation_masks(state, head_atts, preset)
+
+    incr = preset.EFFECTIVE_BALANCE_INCREMENT
+    total_incr = total // incr
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    in_leak = _in_leak(state, preset)
+
+    for mask in (src_mask, tgt_mask, head_mask):
+        att_incr = int(balances[mask].sum()) // incr
+        hit = eligible & mask
+        miss = eligible & ~mask
+        if in_leak:
+            # Optimal performance cancels to neutral during a leak.
+            rewards[hit] += base_reward[hit]
+        else:
+            rewards[hit] += base_reward[hit] * att_incr // total_incr
+        penalties[miss] += base_reward[miss]
+
+    # Inclusion delay: proposer cut + delay-decayed attester reward.
+    proposer_reward = base_reward // preset.PROPOSER_REWARD_QUOTIENT
+    src_idx = np.nonzero(src_mask)[0]
+    for i in src_idx:
+        rewards[min_prop[i]] += int(proposer_reward[i])
+        max_att = int(base_reward[i]) - int(proposer_reward[i])
+        rewards[i] += max_att // int(min_delay[i])
+
+    if in_leak:
+        delay = _finality_delay(state, preset)
+        el = np.nonzero(eligible)[0]
+        penalties[el] += (BASE_REWARDS_PER_EPOCH * base_reward[el]
+                          - proposer_reward[el])
+        lazy = eligible & ~tgt_mask
+        penalties[lazy] += (balances[lazy] * delay
+                            // preset.INACTIVITY_PENALTY_QUOTIENT)
+
+    bal = np.asarray(state.balances, dtype=np.int64)
+    state.balances[:] = np.maximum(bal + rewards - penalties, 0).astype(
+        np.uint64)
+
+
+def process_participation_record_updates(state) -> None:
+    """Rotate the pending-attestation lists (`base/` record updates)."""
+    state.previous_epoch_attestations = list(
+        state.current_epoch_attestations)
+    state.current_epoch_attestations = []

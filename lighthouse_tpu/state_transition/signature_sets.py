@@ -153,6 +153,89 @@ def sync_aggregate_signature_set(state, sync_aggregate, slot: int,
         message=compute_signing_root(block_root_fn(previous_slot), domain))
 
 
+def selection_proof_signature_set(state, slot: int, aggregator_index: int,
+                                  selection_proof: bytes, pubkey_cache,
+                                  preset) -> SignatureSet:
+    """Aggregator slot-selection proof: BLS over the slot
+    (``signature_sets.rs`` aggregate selection-proof arm)."""
+    from ..ssz import uint64 as _u64
+    domain = get_domain(state, Domain.SELECTION_PROOF,
+                        compute_epoch_at_slot(slot, preset.SLOTS_PER_EPOCH),
+                        preset)
+    return SignatureSet(
+        signature=Signature.deserialize(selection_proof),
+        signing_keys=[pubkey_cache.get(state.validators, aggregator_index)],
+        message=compute_signing_root(_u64.hash_tree_root(slot), domain))
+
+
+def aggregate_and_proof_signature_set(state, signed_aggregate, pubkey_cache,
+                                      preset) -> SignatureSet:
+    """The aggregator's signature over the AggregateAndProof container
+    (``signature_sets.rs`` signed_aggregate arm)."""
+    msg = signed_aggregate.message
+    slot = int(msg.aggregate.data.slot)
+    domain = get_domain(state, Domain.AGGREGATE_AND_PROOF,
+                        compute_epoch_at_slot(slot, preset.SLOTS_PER_EPOCH),
+                        preset)
+    return SignatureSet(
+        signature=Signature.deserialize(signed_aggregate.signature),
+        signing_keys=[pubkey_cache.get(state.validators,
+                                       int(msg.aggregator_index))],
+        message=compute_signing_root(msg, domain))
+
+
+def sync_committee_message_signature_set(state, message, pubkey_cache,
+                                         preset) -> SignatureSet:
+    """A single sync-committee member's vote over a beacon block root
+    (``signature_sets.rs`` sync_committee_message arm)."""
+    domain = get_domain(state, Domain.SYNC_COMMITTEE,
+                        compute_epoch_at_slot(int(message.slot),
+                                              preset.SLOTS_PER_EPOCH),
+                        preset)
+    return SignatureSet(
+        signature=Signature.deserialize(message.signature),
+        signing_keys=[pubkey_cache.get(state.validators,
+                                       int(message.validator_index))],
+        message=compute_signing_root(
+            bytes(message.beacon_block_root), domain))
+
+
+def sync_selection_proof_signature_set(state, contribution_and_proof,
+                                       pubkey_cache, preset, T) -> SignatureSet:
+    """Sync-subcommittee aggregator selection proof over
+    SyncAggregatorSelectionData (``signature_sets.rs``
+    sync-selection-proof arm)."""
+    c = contribution_and_proof.contribution
+    slot = int(c.slot)
+    data = T.SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=int(c.subcommittee_index))
+    domain = get_domain(state, Domain.SYNC_COMMITTEE_SELECTION_PROOF,
+                        compute_epoch_at_slot(slot, preset.SLOTS_PER_EPOCH),
+                        preset)
+    return SignatureSet(
+        signature=Signature.deserialize(
+            contribution_and_proof.selection_proof),
+        signing_keys=[pubkey_cache.get(
+            state.validators, int(contribution_and_proof.aggregator_index))],
+        message=compute_signing_root(data, domain))
+
+
+def contribution_and_proof_signature_set(state, signed_contribution,
+                                         pubkey_cache, preset) -> SignatureSet:
+    """The sync aggregator's signature over ContributionAndProof
+    (``signature_sets.rs`` signed_contribution_and_proof arm)."""
+    msg = signed_contribution.message
+    slot = int(msg.contribution.slot)
+    domain = get_domain(state, Domain.CONTRIBUTION_AND_PROOF,
+                        compute_epoch_at_slot(slot, preset.SLOTS_PER_EPOCH),
+                        preset)
+    return SignatureSet(
+        signature=Signature.deserialize(signed_contribution.signature),
+        signing_keys=[pubkey_cache.get(state.validators,
+                                       int(msg.aggregator_index))],
+        message=compute_signing_root(msg, domain))
+
+
 def bls_to_execution_change_signature_set(state, signed_change,
                                           genesis_fork_version: bytes,
                                           preset) -> SignatureSet:

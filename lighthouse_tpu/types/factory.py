@@ -192,6 +192,10 @@ class SpecTypes:
             message: ContributionAndProof
             signature: Bytes96
 
+        class SyncAggregatorSelectionData(Container):
+            slot: uint64
+            subcommittee_index: uint64
+
         class Withdrawal(Container):
             index: uint64
             validator_index: uint64

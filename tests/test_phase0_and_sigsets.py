@@ -1,0 +1,136 @@
+"""Phase0 epoch processing + the gossip signature-set kinds.
+
+Mirrors `per_epoch_processing/base` behaviour (justification from
+PendingAttestations, base-reward components, leak penalties) and the
+remaining `signature_sets.rs` arms (selection proofs, aggregate-and-proof,
+sync-committee message/contribution)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.state_transition import signature_sets as sigs
+from lighthouse_tpu.state_transition.genesis import interop_secret_key
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import ChainSpec, ForkName
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+def _phase0_harness(n=16):
+    B.set_backend("fake")
+    spec = ChainSpec.minimal()
+    return StateHarness(n_validators=n, fork=ForkName.PHASE0, preset=MINIMAL,
+                        spec=spec)
+
+
+def test_phase0_chain_justifies_and_rewards():
+    h = _phase0_harness()
+    try:
+        balances_before = np.asarray(h.state.balances).copy()
+        h.extend_chain(34)  # into epoch 4 (justify @2, finalize @3)
+        st = h.state
+        # Full participation justifies and finalizes.
+        assert int(st.current_justified_checkpoint.epoch) >= 1
+        assert int(st.finalized_checkpoint.epoch) >= 1
+        # Everyone earned rewards (full participation, no leak).
+        assert (np.asarray(st.balances) > balances_before).all()
+        # Pending attestation lists rotated.
+        assert len(st.previous_epoch_attestations) > 0
+    finally:
+        B.set_backend("python")
+
+
+def test_phase0_missing_attesters_are_penalized():
+    h = _phase0_harness()
+    try:
+        # Build blocks with NO attestations: everyone misses.
+        for _ in range(17):
+            signed = h.build_block(attestations=[])
+            h.apply_block(signed)
+        st = h.state
+        assert int(st.current_justified_checkpoint.epoch) == 0
+        # All eligible validators lost balance (3 × base_reward per epoch).
+        assert (np.asarray(st.balances) < 32 * 10**9).all()
+    finally:
+        B.set_backend("python")
+
+
+def test_phase0_upgrades_to_altair():
+    B.set_backend("fake")
+    try:
+        spec = ChainSpec.minimal()
+        spec.altair_fork_epoch = 2
+        h = StateHarness(n_validators=16, fork=ForkName.PHASE0,
+                         preset=MINIMAL, spec=spec)
+        h.extend_chain(20)  # crosses the altair activation epoch
+        assert h.fork_at(int(h.state.slot)) == ForkName.ALTAIR
+        assert hasattr(h.state, "current_epoch_participation")
+    finally:
+        B.set_backend("python")
+
+
+def test_gossip_signature_set_kinds_verify():
+    B.set_backend("python")
+    h = StateHarness(n_validators=8, preset=MINIMAL)
+    h.extend_chain(1)  # a block at slot 1 so slot-0/1 roots resolve
+    st = h.state
+    T = h.T
+    cache = sigs.PubkeyCache()
+    sk3 = interop_secret_key(3)
+
+    # Selection proof.
+    from lighthouse_tpu.state_transition.helpers import (
+        compute_signing_root, get_domain)
+    from lighthouse_tpu.types.chain_spec import Domain
+    from lighthouse_tpu.ssz import uint64 as u64
+    slot = 1
+    dom = get_domain(st, Domain.SELECTION_PROOF, 0, h.preset)
+    proof = sk3.sign(compute_signing_root(
+        u64.hash_tree_root(slot), dom)).serialize()
+    pset = sigs.selection_proof_signature_set(st, slot, 3, proof, cache,
+                                              h.preset)
+    assert B.verify_signature_sets([pset])
+
+    # Aggregate-and-proof over a real attestation.
+    att = h.attestations_for_slot(st, int(st.slot) - 1)[0]
+    agg = T.AggregateAndProof(aggregator_index=3, aggregate=att,
+                              selection_proof=proof)
+    dom = get_domain(st, Domain.AGGREGATE_AND_PROOF, 0, h.preset)
+    sig = sk3.sign(compute_signing_root(agg, dom)).serialize()
+    signed = T.SignedAggregateAndProof(message=agg, signature=sig)
+    assert B.verify_signature_sets([
+        sigs.aggregate_and_proof_signature_set(st, signed, cache, h.preset)])
+
+    # Sync committee message.
+    root = b"\x77" * 32
+    dom = get_domain(st, Domain.SYNC_COMMITTEE, 0, h.preset)
+    msg_sig = sk3.sign(compute_signing_root(root, dom)).serialize()
+    msg = T.SyncCommitteeMessage(slot=1, beacon_block_root=root,
+                                 validator_index=3, signature=msg_sig)
+    assert B.verify_signature_sets([
+        sigs.sync_committee_message_signature_set(st, msg, cache, h.preset)])
+
+    # Sync selection proof + contribution-and-proof.
+    contrib = T.SyncCommitteeContribution(
+        slot=1, beacon_block_root=root, subcommittee_index=0,
+        aggregation_bits=[True] * h.preset.sync_subcommittee_size,
+        signature=b"\xc0" + b"\x00" * 95)
+    sel_data = T.SyncAggregatorSelectionData(slot=1, subcommittee_index=0)
+    dom = get_domain(st, Domain.SYNC_COMMITTEE_SELECTION_PROOF, 0, h.preset)
+    sel_sig = sk3.sign(compute_signing_root(sel_data, dom)).serialize()
+    cap = T.ContributionAndProof(aggregator_index=3, contribution=contrib,
+                                 selection_proof=sel_sig)
+    assert B.verify_signature_sets([
+        sigs.sync_selection_proof_signature_set(st, cap, cache, h.preset,
+                                                T)])
+    dom = get_domain(st, Domain.CONTRIBUTION_AND_PROOF, 0, h.preset)
+    cap_sig = sk3.sign(compute_signing_root(cap, dom)).serialize()
+    signed_cap = T.SignedContributionAndProof(message=cap, signature=cap_sig)
+    assert B.verify_signature_sets([
+        sigs.contribution_and_proof_signature_set(st, signed_cap, cache,
+                                                  h.preset)])
+    # Tampering any of them fails.
+    bad = T.SignedContributionAndProof(
+        message=cap, signature=sk3.sign(b"wrong").serialize())
+    assert not B.verify_signature_sets([
+        sigs.contribution_and_proof_signature_set(st, bad, cache, h.preset)])
