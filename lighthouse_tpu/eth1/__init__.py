@@ -1,0 +1,152 @@
+"""Eth1 bridge: deposit cache, block cache, eth1-data voting, genesis.
+
+Counterpart of ``beacon_node/eth1`` (``/root/reference/beacon_node/eth1/
+src/``) and ``beacon_node/genesis``: ingested deposit-contract logs feed a
+Merkle deposit tree (proof source for blocks), an eth1 block cache backs
+the in-range eth1_data vote, and :func:`genesis_from_deposits` builds the
+full genesis state by replaying deposits
+(``genesis/src/eth1_genesis_service.rs`` + ``state_processing/src/
+genesis.rs``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops.merkle_proof import DepositTree
+from ..types.chain_spec import ForkName, GENESIS_EPOCH
+
+
+@dataclass
+class Eth1Block:
+    """`block_cache.rs` Eth1Block."""
+    hash: bytes
+    number: int
+    timestamp: int
+    deposit_root: bytes
+    deposit_count: int
+
+
+class DepositCache:
+    """Ordered deposit logs + proof tree (`deposit_cache.rs`)."""
+
+    def __init__(self, depth: int = 32):
+        self.tree = DepositTree(depth)
+        self.logs: List[object] = []  # DepositData in log order
+
+    def insert_log(self, index: int, deposit_data) -> None:
+        if index != len(self.logs):
+            raise ValueError(f"non-contiguous deposit log {index}, "
+                             f"expected {len(self.logs)}")
+        self.logs.append(deposit_data)
+        self.tree.push(deposit_data.tree_hash_root())
+
+    def get_deposits(self, start: int, end: int, T) -> List:
+        """Deposits [start, end) with proofs valid against the tree at
+        ``end`` deposits (`deposit_cache.rs get_deposits`)."""
+        if end > len(self.logs):
+            raise ValueError("deposit range beyond known logs")
+        sub = DepositTree(self.tree.tree.depth)
+        for d in self.logs[:end]:
+            sub.push(d.tree_hash_root())
+        return [T.Deposit(proof=sub.proof(i), data=self.logs[i])
+                for i in range(start, end)]
+
+    def root_at(self, count: int) -> bytes:
+        sub = DepositTree(self.tree.tree.depth)
+        for d in self.logs[:count]:
+            sub.push(d.tree_hash_root())
+        return sub.root()
+
+
+class BlockCache:
+    def __init__(self):
+        self.by_number: Dict[int, Eth1Block] = {}
+
+    def insert(self, block: Eth1Block) -> None:
+        self.by_number[block.number] = block
+
+    def latest(self) -> Optional[Eth1Block]:
+        if not self.by_number:
+            return None
+        return self.by_number[max(self.by_number)]
+
+
+class Eth1Service:
+    """Polling service role (`service.rs`): callers push logs/blocks; the
+    chain asks for the eth1 vote."""
+
+    def __init__(self, preset, spec):
+        self.preset = preset
+        self.spec = spec
+        self.deposits = DepositCache(preset.DEPOSIT_CONTRACT_TREE_DEPTH)
+        self.blocks = BlockCache()
+
+    def eth1_data_for_vote(self, state, T):
+        """`get_eth1_vote`: pick the latest in-range block's eth1 data
+        (majority voting simplified to freshest-valid, like the reference's
+        fallback when no majority exists)."""
+        latest = self.blocks.latest()
+        if latest is None or latest.deposit_count < int(
+                state.eth1_data.deposit_count):
+            return state.eth1_data
+        return T.Eth1Data(deposit_root=latest.deposit_root,
+                          deposit_count=latest.deposit_count,
+                          block_hash=latest.hash)
+
+
+def genesis_from_deposits(deposits: List, eth1_block_hash: bytes,
+                          eth1_timestamp: int, preset, spec, T,
+                          fork: ForkName = ForkName.PHASE0):
+    """``initialize_beacon_state_from_eth1``
+    (``state_processing/src/genesis.rs``): replay every deposit, activate
+    validators with full effective balance, stamp genesis metadata.
+    Returns None-equivalent validity via ``is_valid_genesis_state``
+    semantics (caller checks validator count)."""
+    from ..state_transition.genesis import interop_genesis_state
+    from ..state_transition.per_block import apply_deposit
+    from ..state_transition.upgrade import upgrade_state
+
+    # Start from an empty-registry state skeleton at the fork.
+    state = interop_genesis_state(0, 0, preset, spec, T, fork=fork)
+    state.genesis_time = (eth1_timestamp + spec.genesis_delay)
+    state.eth1_data = T.Eth1Data(
+        deposit_root=b"\x00" * 32, deposit_count=len(deposits),
+        block_hash=eth1_block_hash)
+    for i in range(preset.EPOCHS_PER_HISTORICAL_VECTOR):
+        state.randao_mixes.set(i, eth1_block_hash)
+
+    # Apply deposits (signature-checked; invalid ones skip, per spec).
+    for deposit in deposits:
+        apply_deposit(state, deposit.data, preset, spec, T)
+    state.eth1_deposit_index = len(deposits)
+
+    # Activate genesis validators (`genesis.rs` activation loop) —
+    # columnar: everyone at MAX_EFFECTIVE_BALANCE activates at genesis.
+    reg = state.validators
+    n = len(reg)
+    if n:
+        bal = np.asarray(state.balances[:n], dtype=np.uint64)
+        eff = np.minimum(
+            bal - bal % preset.EFFECTIVE_BALANCE_INCREMENT,
+            preset.MAX_EFFECTIVE_BALANCE).astype(np.uint64)
+        reg.wcol("effective_balance")[:] = eff
+        genesis_active = eff >= preset.MAX_EFFECTIVE_BALANCE
+        reg.wcol("activation_eligibility_epoch")[genesis_active] = \
+            GENESIS_EPOCH
+        reg.wcol("activation_epoch")[genesis_active] = GENESIS_EPOCH
+    state.genesis_validators_root = type(state).FIELDS[
+        "validators"].hash_tree_root(reg)
+    return state
+
+
+def is_valid_genesis_state(state, preset, spec) -> bool:
+    """`is_valid_genesis_state` (`genesis.rs`)."""
+    if int(state.genesis_time) < spec.min_genesis_time:
+        return False
+    from ..state_transition.helpers import is_active_at
+    active = int(is_active_at(state.validators, GENESIS_EPOCH).sum())
+    return active >= spec.min_genesis_active_validator_count

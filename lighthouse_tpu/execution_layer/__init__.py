@@ -1,0 +1,149 @@
+"""Execution layer: engine-API seam + in-process mock EL.
+
+Counterpart of ``beacon_node/execution_layer``
+(``/root/reference/beacon_node/execution_layer/src/``): the ``Engine``
+abstraction (newPayload / forkchoiceUpdated / getPayload), a
+primary-with-fallback engine list, and the hermetic
+``MockExecutionLayer``/``ExecutionBlockGenerator`` the whole test suite
+runs against (``execution_layer/src/test_utils/`` — a hash-linked payload
+chain with validity-injection hooks).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+
+class PayloadStatus(str, Enum):
+    """engine_newPayload statuses (`engine_api.rs` PayloadStatusV1)."""
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+class Engine:
+    """One execution engine endpoint (the JSON-RPC transport seam)."""
+
+    def new_payload(self, payload) -> PayloadStatus:
+        raise NotImplementedError
+
+    def forkchoice_updated(self, head_hash: bytes, safe_hash: bytes,
+                           finalized_hash: bytes,
+                           payload_attributes=None) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_payload(self, payload_id: bytes):
+        raise NotImplementedError
+
+
+class ExecutionLayer:
+    """Primary/fallback engine routing (`engines.rs` state machine)."""
+
+    def __init__(self, engines: List[Engine]):
+        if not engines:
+            raise EngineError("at least one engine required")
+        self.engines = list(engines)
+
+    def _first_up(self, fn: Callable):
+        last: Optional[Exception] = None
+        for engine in self.engines:
+            try:
+                return fn(engine)
+            except EngineError as e:
+                last = e
+        raise EngineError(f"all engines failed: {last}")
+
+    def notify_new_payload(self, payload) -> PayloadStatus:
+        return self._first_up(lambda e: e.new_payload(payload))
+
+    def notify_forkchoice_updated(self, head: bytes, safe: bytes,
+                                  finalized: bytes,
+                                  payload_attributes=None):
+        return self._first_up(lambda e: e.forkchoice_updated(
+            head, safe, finalized, payload_attributes))
+
+    def get_payload(self, payload_id: bytes):
+        return self._first_up(lambda e: e.get_payload(payload_id))
+
+    def payload_verifier(self):
+        """The `per_block.process_execution_payload` hook: payload →
+        bool (the `payload_notifier` of `block_verification.rs:1335`)."""
+        def verify(payload) -> bool:
+            return self.notify_new_payload(payload) == PayloadStatus.VALID
+        return verify
+
+
+@dataclass
+class _MockBlock:
+    block_hash: bytes
+    parent_hash: bytes
+    block_number: int
+    timestamp: int
+
+
+class ExecutionBlockGenerator:
+    """Hash-linked execution chain (`test_utils/execution_block_generator.rs`)."""
+
+    def __init__(self, terminal_block_hash: bytes = b"\x42" * 32):
+        genesis = _MockBlock(terminal_block_hash, b"\x00" * 32, 0, 0)
+        self.blocks: Dict[bytes, _MockBlock] = {genesis.block_hash: genesis}
+        self.head = genesis.block_hash
+
+    def insert(self, parent_hash: bytes, block_number: int,
+               timestamp: int) -> bytes:
+        h = hashlib.sha256(parent_hash + block_number.to_bytes(8, "little")
+                           ).digest()
+        self.blocks[h] = _MockBlock(h, parent_hash, block_number, timestamp)
+        return h
+
+
+class MockExecutionLayer(Engine):
+    """In-process fake engine (`test_utils/mod.rs` MockExecutionLayer):
+    validates payload linkage against the generator chain; test hooks can
+    force any status (`test_utils/hook.rs`)."""
+
+    def __init__(self):
+        self.generator = ExecutionBlockGenerator()
+        self.status_hook: Optional[Callable] = None
+        self.payloads_seen: List[bytes] = []
+        self._pending: Dict[bytes, dict] = {}
+
+    def new_payload(self, payload) -> PayloadStatus:
+        block_hash = bytes(payload.block_hash)
+        self.payloads_seen.append(block_hash)
+        if self.status_hook is not None:
+            forced = self.status_hook(payload)
+            if forced is not None:
+                return forced
+        parent = bytes(payload.parent_hash)
+        if parent not in self.generator.blocks:
+            return PayloadStatus.SYNCING
+        self.generator.blocks[block_hash] = _MockBlock(
+            block_hash, parent, int(payload.block_number),
+            int(payload.timestamp))
+        return PayloadStatus.VALID
+
+    def forkchoice_updated(self, head_hash, safe_hash, finalized_hash,
+                           payload_attributes=None):
+        if head_hash not in self.generator.blocks:
+            return None
+        self.generator.head = head_hash
+        if payload_attributes is not None:
+            pid = hashlib.sha256(head_hash + b"pid").digest()[:8]
+            self._pending[pid] = {"parent": head_hash,
+                                  "attrs": payload_attributes}
+            return pid
+        return None
+
+    def get_payload(self, payload_id: bytes):
+        if payload_id not in self._pending:
+            raise EngineError("unknown payload id")
+        return self._pending.pop(payload_id)

@@ -1,0 +1,155 @@
+"""Light-client sync protocol: types, production, verification.
+
+Counterpart of the reference's light-client surface
+(``/root/reference/consensus/types/src/light_client_{bootstrap,update,
+finality_update,optimistic_update}.rs`` and ``beacon_node/beacon_chain/src/
+light_client_{finality,optimistic}_update_verification.rs``): bootstrap =
+header + current sync committee + a Merkle branch into the state; updates
+carry the attested/finalized headers, the next-sync-committee branch and
+the sync aggregate that signed them.
+
+Branches are computed from the state's container layout via
+:func:`state_field_proof` — the per-field roots the incremental tree-hash
+cache already maintains fold into a small tree whose siblings form the
+proof (``merkle_proof.rs`` generalized-index idea over this build's
+layout).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .ops.merkle import ZERO_HASHES_BYTES
+
+
+def _field_roots(state) -> List[bytes]:
+    return [ftype.hash_tree_root(getattr(state, fname))
+            for fname, ftype in type(state).FIELDS.items()]
+
+
+def _tree_width(n: int) -> int:
+    w = 1
+    while w < n:
+        w *= 2
+    return w
+
+
+def state_field_proof(state, field_name: str) -> tuple[List[bytes], int]:
+    """(branch, field index) proving ``field_name``'s root against the
+    state root."""
+    names = list(type(state).FIELDS)
+    idx = names.index(field_name)
+    leaves = _field_roots(state)
+    width = _tree_width(len(leaves))
+    level = leaves + [ZERO_HASHES_BYTES[0]] * (width - len(leaves))
+    branch: List[bytes] = []
+    i = idx
+    while len(level) > 1:
+        branch.append(level[i ^ 1])
+        level = [hashlib.sha256(level[j] + level[j + 1]).digest()
+                 for j in range(0, len(level), 2)]
+        i //= 2
+    return branch, idx
+
+
+def verify_field_proof(field_root: bytes, branch: List[bytes], index: int,
+                       state_root: bytes) -> bool:
+    node = field_root
+    i = index
+    for sib in branch:
+        node = (hashlib.sha256(sib + node).digest() if i & 1
+                else hashlib.sha256(node + sib).digest())
+        i //= 2
+    return node == state_root
+
+
+@dataclass
+class LightClientBootstrap:
+    """`LightClientBootstrap` — served via RPC (`rpc/protocol.rs:178`)."""
+    header: object                       # BeaconBlockHeader
+    current_sync_committee: object
+    current_sync_committee_branch: List[bytes]
+
+    def verify(self, trusted_block_root: bytes, state, T) -> bool:
+        if self.header.tree_hash_root() != trusted_block_root:
+            return False
+        names = list(type(state).FIELDS)
+        idx = names.index("current_sync_committee")
+        return verify_field_proof(
+            self.current_sync_committee.tree_hash_root(),
+            self.current_sync_committee_branch, idx,
+            bytes(self.header.state_root))
+
+
+@dataclass
+class LightClientUpdate:
+    """`LightClientUpdate` — sync-committee period advancement."""
+    attested_header: object
+    next_sync_committee: object
+    next_sync_committee_branch: List[bytes]
+    finalized_header: Optional[object]
+    finality_branch: List[bytes]
+    sync_aggregate: object
+    signature_slot: int
+
+
+@dataclass
+class LightClientFinalityUpdate:
+    """`LightClientFinalityUpdate` — gossip topic payload."""
+    attested_header: object
+    finalized_header: object
+    finality_branch: List[bytes]
+    sync_aggregate: object
+    signature_slot: int
+
+
+@dataclass
+class LightClientOptimisticUpdate:
+    attested_header: object
+    sync_aggregate: object
+    signature_slot: int
+
+
+class LightClientServer:
+    """Produces light-client artifacts from a chain
+    (`beacon_chain/src/light_client_*` production paths)."""
+
+    def __init__(self, chain):
+        self.chain = chain
+
+    def _header(self, state, block_root: Optional[bytes] = None):
+        hdr = state.latest_block_header.copy()
+        if bytes(hdr.state_root) == b"\x00" * 32:
+            hdr.state_root = state.tree_hash_root()
+        return hdr
+
+    def bootstrap(self, block_root: Optional[bytes] = None
+                  ) -> LightClientBootstrap:
+        state = self.chain.head.state
+        branch, _ = state_field_proof(state, "current_sync_committee")
+        return LightClientBootstrap(
+            header=self._header(state),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=branch)
+
+    def optimistic_update(self, sync_aggregate,
+                          signature_slot: int) -> LightClientOptimisticUpdate:
+        state = self.chain.head.state
+        return LightClientOptimisticUpdate(
+            attested_header=self._header(state),
+            sync_aggregate=sync_aggregate, signature_slot=signature_slot)
+
+    def finality_update(self, sync_aggregate,
+                        signature_slot: int) -> LightClientFinalityUpdate:
+        state = self.chain.head.state
+        branch, _ = state_field_proof(state, "finalized_checkpoint")
+        fin_root = bytes(state.finalized_checkpoint.root)
+        fin_block = self.chain.store.get_block(fin_root)
+        fin_header = (fin_block.message if fin_block is not None else None)
+        return LightClientFinalityUpdate(
+            attested_header=self._header(state),
+            finalized_header=fin_header,
+            finality_branch=branch,
+            sync_aggregate=sync_aggregate, signature_slot=signature_slot)

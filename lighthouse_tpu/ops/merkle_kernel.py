@@ -169,6 +169,39 @@ def chunk_roots(planes: jnp.ndarray, chunk_log2: int = CHUNK_LOG2,
     )(planes)
 
 
+def _hash64_pallas_kernel(l_ref, r_ref, o_ref):
+    left = [l_ref[w:w + 1, :] for w in range(8)]
+    right = [r_ref[w:w + 1, :] for w in range(8)]
+    o_ref[:] = jnp.concatenate(hash64_planes(left, right), axis=0)
+
+
+def hash64_pallas(left: jnp.ndarray, right: jnp.ndarray,
+                  block_log2: int = 15) -> jnp.ndarray:
+    """``hash64`` as a Pallas kernel over word planes: (n, 8) pairs →
+    (n, 8) digests with the two compressions fully unrolled in VMEM (the
+    XLA-scan ``hash64`` round-trips its 24-word working set through HBM
+    every round — ~10× slower at registry widths)."""
+    n = left.shape[0]
+    b = 1 << block_log2
+    if n % b:
+        raise ValueError(f"lane count {n} not a multiple of {b}")
+    g = n // b
+    lp = left.T
+    rp = right.T
+    out = pl.pallas_call(
+        _hash64_pallas_kernel,
+        grid=(g,),
+        in_specs=[pl.BlockSpec((8, b), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((8, b), lambda i: (0, i),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, b), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.uint32),
+    )(lp, rp)
+    return out.T
+
+
 @lru_cache(maxsize=8)
 def brev_indices(chunk_log2: int) -> np.ndarray:
     """``(2^chunk_log2,) int32``: bit-reversal permutation of chunk slots.

@@ -136,6 +136,32 @@ class IncrementalMerkleCache:
                 root_bytes, int(k if length is None else length))
         return root_bytes
 
+    def update_rows(self, idx: np.ndarray, rows: np.ndarray,
+                    count: int, length: int | None = None) -> bytes:
+        """Sparse alternative to :meth:`root_words`: the caller diffed at
+        the SOURCE level and supplies only the changed chunk rows
+        (``idx`` ascending, ``rows`` (k, 8)).  ``count`` is the new total
+        chunk count (must keep the same padded width)."""
+        if self.levels is None:
+            raise ValueError("cold cache: call root_words first")
+        w = self.levels[0].shape[0]
+        if _next_pow2(max(count, 1)) != w:
+            raise ValueError("width changed: use root_words")
+        if idx.size:
+            self.levels[0][idx] = rows
+            self._propagate(idx)
+        root = self.levels[-1][0]
+        lvl = len(self.levels) - 1
+        while lvl < self.depth:
+            root = _h64_host(root[None], ZERO_HASHES[lvl][None])[0]
+            lvl += 1
+        root_bytes = words_to_bytes(root)
+        if self.mixin_length:
+            HASH_COUNT[0] += 1
+            root_bytes = mix_in_length_host(
+                root_bytes, int(count if length is None else length))
+        return root_bytes
+
     def copy(self) -> "IncrementalMerkleCache":
         out = IncrementalMerkleCache.__new__(IncrementalMerkleCache)
         out.depth = self.depth

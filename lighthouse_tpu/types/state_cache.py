@@ -98,11 +98,67 @@ class RegistryCache:
         return out
 
 
+_PACKED_PER_CHUNK = {8: 4, 1: 32}  # u64 → 4/chunk, u8 → 32/chunk
+
+
+class _PackedSourceCache:
+    """Source-level diff for packed uint columns (balances, participation,
+    inactivity): compare the raw column against the stored copy (one
+    vectorized pass over the source values — 4-32× less traffic than
+    leaf-word diffing and no full reconversion), pack ONLY the changed
+    chunks, and hand the sparse update to the interior-node cache."""
+
+    def __init__(self, limit_chunks: int, mixin_length: bool):
+        self.tree = IncrementalMerkleCache(limit_chunks,
+                                           mixin_length=mixin_length)
+        self.src: np.ndarray | None = None
+
+    @staticmethod
+    def _pack_chunks(vals: np.ndarray) -> np.ndarray:
+        """(k, per) source values → (k, 8) big-endian chunk words (SSZ
+        little-endian packing inside each 32-byte chunk)."""
+        le = np.ascontiguousarray(
+            vals.astype(vals.dtype.newbyteorder("<"), copy=False))
+        return np.frombuffer(le.tobytes(), dtype=">u4").astype(
+            np.uint32).reshape(vals.shape[0], 8)
+
+    def root(self, arr: np.ndarray) -> bytes:
+        per = _PACKED_PER_CHUNK[arr.dtype.itemsize]
+        n = arr.shape[0]
+        n_chunks = (n + per - 1) // per
+        pad = n_chunks * per - n
+        if self.src is None or self.src.shape[0] != n:
+            self.src = arr.copy()
+            padded = np.concatenate([arr, np.zeros(pad, arr.dtype)])                 if pad else arr
+            return self.tree.root_words(
+                self._pack_chunks(padded.reshape(n_chunks, per)), length=n)
+        changed = np.nonzero(self.src != arr)[0]
+        if changed.size == 0:
+            return self.tree.update_rows(
+                np.empty(0, np.int64), np.empty((0, 8), np.uint32),
+                n_chunks, length=n)
+        chunk_idx = np.unique(changed // per)
+        self.src[changed] = arr[changed]
+        flat = (chunk_idx[:, None] * per
+                + np.arange(per)[None, :]).reshape(-1)
+        vals = np.where(flat < n, arr[np.minimum(flat, n - 1)],
+                        np.zeros(1, arr.dtype))
+        rows = self._pack_chunks(vals.reshape(chunk_idx.shape[0], per))
+        return self.tree.update_rows(chunk_idx, rows, n_chunks, length=n)
+
+    def copy(self) -> "_PackedSourceCache":
+        out = _PackedSourceCache.__new__(_PackedSourceCache)
+        out.tree = self.tree.copy()
+        out.src = None if self.src is None else self.src.copy()
+        return out
+
+
 class StateHashCache:
     """Per-state-instance cache over all fields + the container fold."""
 
     def __init__(self):
         self.fields: dict[str, IncrementalMerkleCache] = {}
+        self.packed: dict[str, _PackedSourceCache] = {}
         self.registry = RegistryCache()
         self.small: dict[str, tuple[bytes, bytes]] = {}  # fname → (enc, root)
 
@@ -112,6 +168,14 @@ class StateHashCache:
             v = getattr(state, fname)
             if fname == "validators":
                 leaves.append(self.registry.root(v, ftype.LIMIT))
+            elif getattr(ftype, "DTYPE", None) is not None                     and isinstance(v, np.ndarray) and v.ndim == 1                     and v.dtype.itemsize in _PACKED_PER_CHUNK:
+                cache = self.packed.get(fname)
+                if cache is None:
+                    _w, limit_chunks, length = ftype.leaf_words(v)
+                    cache = _PackedSourceCache(limit_chunks,
+                                               length is not None)
+                    self.packed[fname] = cache
+                leaves.append(cache.root(np.asarray(v)))
             elif hasattr(ftype, "leaf_words"):
                 words, limit_chunks, length = ftype.leaf_words(v)
                 cache = self.fields.get(fname)
@@ -135,6 +199,7 @@ class StateHashCache:
     def copy(self) -> "StateHashCache":
         out = StateHashCache.__new__(StateHashCache)
         out.fields = {k: c.copy() for k, c in self.fields.items()}
+        out.packed = {k: c.copy() for k, c in self.packed.items()}
         out.registry = self.registry.copy()
         out.small = dict(self.small)
         return out

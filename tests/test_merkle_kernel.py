@@ -94,3 +94,30 @@ def test_merkle_root_chunked_rejects_bad_shapes():
     with pytest.raises(ValueError):
         merkle_root_chunked(jnp.zeros((16, 8), np.uint32), 2,
                             chunk_log2=3, use_kernel=False)
+
+
+def test_registry_root_device_matches_host_path():
+    """The fused device-resident registry root (expansion-tree form) must
+    equal the per-level host path — including the zero-cap semantics
+    (record-level zero chunks, not zero-record roots)."""
+    import numpy as np
+    from lighthouse_tpu.types.validators import (
+        ValidatorRegistry, registry_device_columns, registry_root_device)
+
+    rng = np.random.default_rng(3)
+    n = 1 << 12  # small enough for the pure-XLA (CPU) kernel path
+    reg = ValidatorRegistry(n)
+    reg._n = n
+    reg.init_columns(
+        pubkey=rng.integers(0, 256, (n, 48), dtype=np.uint8),
+        withdrawal_credentials=rng.integers(0, 256, (n, 32), dtype=np.uint8),
+        effective_balance=rng.integers(0, 2**35, n).astype(np.uint64),
+        slashed=rng.integers(0, 2, n).astype(bool),
+        activation_eligibility_epoch=rng.integers(0, 99, n).astype(np.uint64),
+        activation_epoch=rng.integers(0, 99, n).astype(np.uint64),
+        exit_epoch=rng.integers(0, 99, n).astype(np.uint64),
+        withdrawable_epoch=rng.integers(0, 99, n).astype(np.uint64))
+    limit = 1 << 40
+    host = reg.hash_tree_root(limit)
+    cols = registry_device_columns(reg)
+    assert registry_root_device(cols, n, limit) == host

@@ -39,3 +39,21 @@ def test_graft_entry_contract():
     out = jax.block_until_ready(fn(*args))
     assert out.shape == (8,)
     g.dryrun_multichip(8)
+
+
+def test_sharded_g1_sum_matches_host():
+    import jax
+    import numpy as np
+    from lighthouse_tpu.crypto import curve as C
+    from lighthouse_tpu.crypto import limb_curve as LC
+    from lighthouse_tpu.parallel.bls_shard import sharded_g1_sum
+    from lighthouse_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:8])
+    pts = [C.g1_mul(C.G1_GEN, 100 + i) for i in range(16)]
+    arr = np.stack([LC.g1_to_limbs(p) for p in pts])
+    got = LC.g1_from_limbs(np.asarray(sharded_g1_sum(arr, mesh)))
+    want = None
+    for p in pts:
+        want = C.g1_add(want, p)
+    assert got == want
