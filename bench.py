@@ -184,11 +184,8 @@ def main() -> None:
     # Persistent compilation cache: axon remote compiles are slow and
     # occasionally hang; once a kernel compiles successfully the cache
     # makes every later run (including the driver's) hit disk instead.
-    import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    from __graft_entry__ import _enable_compile_cache
+    _enable_compile_cache()
 
     bls = _bls_bench()
     reg = _registry_htr_bench()

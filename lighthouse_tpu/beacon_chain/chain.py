@@ -105,19 +105,30 @@ class BeaconChain:
         return state
 
     def state_for_attestation(self, att):
-        """A state able to compute the attestation's committee — the head
-        state advanced if needed, memoised per (head, slot) so a 64-item
-        gossip batch advances once (shuffling/attester cache role)."""
-        state = self.head.state
+        """A state able to compute the attestation's committee, resolved
+        from the attestation's OWN chain (``beacon_block_root``) — an
+        attestation on a non-head fork may have a different shuffling, so
+        the head state would verify it against the wrong committee (the
+        reference resolves committees from the attestation's target chain,
+        ``attestation_verification.rs``).  Memoised per (root, slot) so a
+        64-item gossip batch advances once (shuffling/attester cache role);
+        bounded to a few entries like the reference's shuffling cache."""
         slot = int(att.data.slot)
-        if int(state.slot) >= slot:
-            return state
-        key = (self.head.root, slot)
+        block_root = bytes(att.data.beacon_block_root)
+        base = self.head.state if block_root == self.head.root else None
+        if base is not None and int(base.slot) >= slot:
+            return base
+        key = (block_root, slot)
         cached = self._advanced_states.get(key)
         if cached is None:
-            cached = process_slots(state.copy(), slot, self.preset,
-                                   self.spec, self.T)
-            self._advanced_states.clear()  # keep only the latest head/slot
+            src = base if base is not None \
+                else self.state_at_block_root(block_root)
+            cached = (src if int(src.slot) >= slot
+                      else process_slots(src.copy(), slot, self.preset,
+                                         self.spec, self.T))
+            while len(self._advanced_states) >= 4:
+                self._advanced_states.pop(
+                    next(iter(self._advanced_states)))
             self._advanced_states[key] = cached
         return cached
 

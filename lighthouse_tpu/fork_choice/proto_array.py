@@ -227,10 +227,16 @@ class ProtoArrayForkChoice:
         if node.execution_status == EXEC_INVALID:
             return False
         je, jr = self.justified_checkpoint
-        fe, _fr = self.finalized_checkpoint
+        fe, fr = self.finalized_checkpoint
         correct_j = (node.justified_epoch, node.justified_root) == (je, jr) \
             or je == 0
-        correct_f = node.finalized_epoch == fe or fe == 0
+        # Compare the finalized ROOT too: a node descending from a
+        # conflicting block finalized at the same epoch number must not
+        # pass viability (`proto_array.rs:897` checks the checkpoint, not
+        # just the epoch).  Nodes at/above the finalized slot carry their
+        # own ancestor root; require it to match ours.
+        correct_f = (node.finalized_epoch, node.finalized_root) == (fe, fr) \
+            or fe == 0
         return correct_j and correct_f
 
     def _leads_to_viable_head(self, node: ProtoNode) -> bool:

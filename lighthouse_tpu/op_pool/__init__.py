@@ -99,12 +99,28 @@ class OperationPool:
         # (current vs previous — mixing them mis-weights boundary packing).
         seen_cur: set[int] = set()
         seen_prev: set[int] = set()
-        cur_part = np.asarray(state.current_epoch_participation)
-        if cur_part.size:
-            seen_cur.update(np.nonzero(cur_part)[0].tolist())
-        prev_part = np.asarray(state.previous_epoch_participation)
-        if prev_part.size:
-            seen_prev.update(np.nonzero(prev_part)[0].tolist())
+        if hasattr(state, "current_epoch_participation"):
+            cur_part = np.asarray(state.current_epoch_participation)
+            if cur_part.size:
+                seen_cur.update(np.nonzero(cur_part)[0].tolist())
+            prev_part = np.asarray(state.previous_epoch_participation)
+            if prev_part.size:
+                seen_prev.update(np.nonzero(prev_part)[0].tolist())
+        # else: phase0 — no participation flags; credited attesters live in
+        # state.{previous,current}_epoch_attestations whose bits→index
+        # resolution needs the committee shuffle, so every attester counts
+        # as fresh (the reference's base-fork packing resolves them via its
+        # epoch cache; over-weighting only costs packing optimality, never
+        # validity).
+        # Candidates must also pass the reference's curr/prev-epoch validity
+        # filters (`attestation.rs` validity_filter): an attestation whose
+        # source disagrees with the proposal state's justified checkpoint
+        # would fail process_attestation in the very block we pack it into.
+        def _cp_key(cp):
+            return (int(cp.epoch), bytes(cp.root))
+
+        want_cur = _cp_key(state.current_justified_checkpoint)
+        want_prev = _cp_key(state.previous_justified_checkpoint)
         candidates = []
         for entry in self.attestations.values():
             for stored in entry:
@@ -113,6 +129,9 @@ class OperationPool:
                 if att_slot + self.preset.MIN_ATTESTATION_INCLUSION_DELAY > slot:
                     continue
                 if att_epoch not in (epoch, epoch - 1):
+                    continue
+                want = want_cur if att_epoch == epoch else want_prev
+                if _cp_key(stored.data.source) != want:
                     continue
                 seen = seen_cur if att_epoch == epoch else seen_prev
                 idx = stored.committee[stored.bits[:len(stored.committee)]]

@@ -40,9 +40,16 @@ def sharded_g1_sum(points: jnp.ndarray, mesh) -> jnp.ndarray:
     def block(pts):  # (local, 3, 26) on each device
         partial = LC.tree_sum(LC.G1_OPS, pts, local)      # (3, 26)
         gathered = jax.lax.all_gather(partial, "batch")   # (d, 3, 26)
-        total = gathered[0]
-        for i in range(1, d):
-            total = LC.point_add(LC.G1_OPS, total, gathered[i])
+        # Fold the gathered row with a scan, NOT an unrolled loop: the
+        # complete-addition formula is ~250 HLO ops per instance and
+        # XLA-CPU compiles each instance in ~80 s — the r3 multichip dry
+        # run timed out on a body with d-1 unrolled copies.  A scan keeps
+        # exactly one instance in the program; d is small (chip count), so
+        # the sequential fold costs nothing at run time.
+        def step(acc, q):
+            return LC.point_add(LC.G1_OPS, acc, q), None
+        acc0 = jnp.asarray(LC.identity_like(LC.G1_OPS, ()))
+        total, _ = jax.lax.scan(step, acc0, gathered)
         return total
 
     fn = shard_map(block, mesh=mesh, in_specs=P("batch"), out_specs=P(),

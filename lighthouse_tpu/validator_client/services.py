@@ -141,21 +141,42 @@ class DoppelgangerService:
         self.start_epoch = start_epoch
         self.log = log.child("doppelganger")
         self.detected: set[bytes] = set()
+        self.complete = False
+        self._probed: set[int] = set()
         # Initially every key is blocked.
         store.doppelganger_blocked = set(store.pubkeys())
 
     def check_epoch(self, epoch: int) -> None:
+        """Probe liveness for the previously-COMPLETED epoch only, and stop
+        for good once the watch window is done.
+
+        The reference never checks an epoch this VC itself may have signed
+        in (``doppelganger_service.rs:253,421``): probing the in-progress
+        epoch after the keys are released would observe our *own*
+        attestations, mark every key as a doppelganger, and re-block them
+        permanently.
+        """
+        if self.complete:
+            return
+        probe = epoch - 1
+        if probe < self.start_epoch or probe in self._probed:
+            return  # no fully-completed watch epoch yet / already probed
+        self._probed.add(probe)
         pks = self.store.pubkeys()
         indices = [self.store.index_by_pubkey[pk] for pk in pks]
         live = self.fallback.first_success(
-            lambda bn: bn.liveness(epoch, indices))
+            lambda bn: bn.liveness(probe, indices))
         for pk, is_live in zip(pks, live):
             if is_live:
                 self.detected.add(pk)
                 self.log.crit("doppelganger detected", pubkey=pk.hex()[:12])
-        if epoch >= self.start_epoch + self.EPOCHS_TO_WATCH:
-            # Watch over: release every undetected key.
+        if len(self._probed) >= self.EPOCHS_TO_WATCH \
+                and probe >= self.start_epoch + self.EPOCHS_TO_WATCH - 1:
+            # Watch over — but only after EPOCHS_TO_WATCH epochs were
+            # actually probed: a VC resuming at epoch N ≥ start+2 must not
+            # release on a single liveness query.  Release is permanent.
             self.store.doppelganger_blocked = set(self.detected)
+            self.complete = True
 
 
 class ValidatorClient:

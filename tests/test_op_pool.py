@@ -117,3 +117,43 @@ def test_attester_slashing_dedup_by_covered_indices():
     pool.insert_attester_slashing(h.make_attester_slashing(h.state, [4, 5]))
     _, ats, _ = pool.get_slashings_and_exits(h.state)
     assert len(ats) == 1  # second covers no new validators
+
+
+def test_get_attestations_phase0_state():
+    """Phase0 states have no participation flags — packing must not raise
+    (ADVICE r3: AttributeError on phase0 block production)."""
+    from lighthouse_tpu.state_transition.committees import get_beacon_committee
+    from lighthouse_tpu.types.chain_spec import ChainSpec, ForkName
+    spec = ChainSpec.minimal()
+    h = StateHarness(n_validators=16, fork=ForkName.PHASE0, preset=MINIMAL,
+                     spec=spec)
+    pool = OperationPool(h.preset, h.spec)
+    h.extend_chain(3)
+    slot = int(h.state.slot) - 1
+    for att in h.attestations_for_slot(h.state, slot):
+        committee = get_beacon_committee(
+            h.state, int(att.data.slot), int(att.data.index), h.preset)
+        pool.insert_attestation(att, np.asarray(committee))
+    packed = pool.get_attestations(h.state, h.T)
+    assert 0 < len(packed) <= h.preset.MAX_ATTESTATIONS
+
+
+def test_get_attestations_filters_mismatched_source():
+    """An attestation whose source disagrees with the proposal state's
+    justified checkpoint must not be packed — it would fail the very block
+    it rides in (reference validity_filter, `attestation.rs`)."""
+    from lighthouse_tpu.state_transition.committees import get_beacon_committee
+    h, pool = _pool_with_chain(3)
+    slot = int(h.state.slot) - 1
+    atts = h.attestations_for_slot(h.state, slot)
+    for att in atts:
+        committee = get_beacon_committee(
+            h.state, int(att.data.slot), int(att.data.index), h.preset)
+        pool.insert_attestation(att, np.asarray(committee))
+    h.state.current_epoch_participation[:] = 0
+    assert pool.get_attestations(h.state, h.T)
+    # Corrupt every stored source: nothing packs any more.
+    for entry in pool.attestations.values():
+        for stored in entry:
+            stored.data.source.root = b"\xee" * 32
+    assert pool.get_attestations(h.state, h.T) == []

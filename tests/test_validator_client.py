@@ -157,3 +157,29 @@ def test_doppelganger_blocks_until_clear():
         assert chain.head.slot == 2
     finally:
         B.set_backend("python")
+
+
+def test_doppelganger_never_reblocks_after_release():
+    """ADVICE r3 (high): after the watch window ends and the VC's own
+    attestations make liveness true, the check must NOT re-block the keys
+    (probe only completed epochs; stop checking once the window is done)."""
+    B.set_backend("fake")
+    try:
+        h, chain, store = _vc_setup()
+        bn = InProcessBeaconNode(chain)
+        vc = ValidatorClient(store, [bn], h.preset, doppelganger=True)
+        for epoch in range(0, 3):
+            vc.doppelganger.check_epoch(epoch)
+        assert not store.doppelganger_blocked
+        assert vc.doppelganger.complete
+        # The released VC signs; its own attestations show up as liveness.
+        cur_epoch = 3
+        for idx in store.indices():
+            chain.observed_attesters.observe(cur_epoch, int(idx))
+        for _ in range(3):  # the per-slot loop keeps calling check_epoch
+            vc.doppelganger.check_epoch(cur_epoch)
+            vc.doppelganger.check_epoch(cur_epoch + 1)
+        assert not store.doppelganger_blocked  # keys stay released
+        assert not vc.doppelganger.detected
+    finally:
+        B.set_backend("python")
