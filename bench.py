@@ -174,8 +174,16 @@ def _incremental_state_root_bench() -> dict:
         t0 = time.perf_counter()
         state.tree_hash_root()
         ts.append((time.perf_counter() - t0) * 1e3)
+    # Cold-path breakdown recorded by registry_cold_device during the cold
+    # root above: the cold build is ONE fused device dispatch, but it must
+    # first move ~117 MB of host-resident columns through the axon tunnel
+    # (measured ~43 MB/s) — production keeps the columns in HBM
+    # (``registry_htr_ms`` is that shape).
+    from lighthouse_tpu.types.validators import LAST_COLD_TIMINGS
     return {
         "state_root_cold_ms": round(cold_ms, 1),
+        "state_root_cold_push_ms": LAST_COLD_TIMINGS.get("push_ms"),
+        "state_root_cold_compute_ms": LAST_COLD_TIMINGS.get("compute_ms"),
         "state_root_incremental_ms": round(min(ts), 2),
     }
 

@@ -63,7 +63,16 @@ def _cheap_checks(chain, att) -> Tuple[np.ndarray, np.ndarray, object]:
     head_root = bytes(att.data.beacon_block_root)
     if not chain.fork_choice.contains_block(head_root):
         raise UnknownHeadBlock(head_root.hex())
-    state = chain.state_for_attestation(att)
+    try:
+        state = chain.state_for_attestation(att)
+    except AttestationError:
+        raise
+    except Exception as e:
+        # Fork-choice may know the block while its state is already pruned
+        # (hot→cold migration); that is a per-attestation failure, not a
+        # batch abort — BlockError escaping here would drop the whole
+        # 64-item gossip batch on one unverified message.
+        raise UnknownHeadBlock(f"state unavailable: {e}") from e
     indices, committee = attesting_indices(state, att, chain.preset)
     epoch = int(att.data.target.epoch)
     fresh = [i for i in indices

@@ -23,7 +23,10 @@ from ..state_transition import signature_sets as sigs
 from ..state_transition.committees import get_beacon_proposer_index
 from ..state_transition.per_slot import process_slots
 from ..store import DBColumn, HotColdDB
-from .attestation_verification import batch_verify_attestations
+from .attestation_verification import (
+    ATTESTATION_PROPAGATION_SLOT_RANGE,
+    batch_verify_attestations,
+)
 from .block_verification import (
     ExecutedBlock,
     GossipVerifiedBlock,
@@ -123,6 +126,17 @@ class BeaconChain:
         if cached is None:
             src = base if base is not None \
                 else self.state_at_block_root(block_root)
+            # Bound the advance: this runs on UNVERIFIED gossip input, and
+            # an attacker naming an ancient fork block would otherwise buy
+            # thousands of slots of state processing per message.  One
+            # epoch beyond the propagation window covers every honest
+            # shuffling lookup (committees depend on the target epoch).
+            max_advance = (ATTESTATION_PROPAGATION_SLOT_RANGE
+                           + self.preset.SLOTS_PER_EPOCH)
+            if slot - int(src.slot) > max_advance:
+                raise BlockError(
+                    f"attestation slot {slot} too far beyond its chain's "
+                    f"state at {int(src.slot)}")
             cached = (src if int(src.slot) >= slot
                       else process_slots(src.copy(), slot, self.preset,
                                          self.spec, self.T))

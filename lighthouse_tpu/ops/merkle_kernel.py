@@ -202,6 +202,47 @@ def hash64_pallas(left: jnp.ndarray, right: jnp.ndarray,
     return out.T
 
 
+def _levels_body(leaves: jnp.ndarray, *, use_kernel: bool):
+    """All tree levels over ``(w, 8)`` u32 leaves (w a power of two), as one
+    traced program: Pallas hash64 for the wide levels, XLA for the tail.
+    Returns ``(levels...)`` with ``levels[0] = leaves``, ``levels[-1]``
+    the ``(1, 8)`` subtree root."""
+    from .sha256 import hash64 as hash64_xla
+
+    pb = 1 << 15
+
+    def h64(a, b):
+        if use_kernel and a.shape[0] >= pb and a.shape[0] % pb == 0:
+            return hash64_pallas(a, b)
+        return hash64_xla(a, b)
+
+    levels = [leaves]
+    cur = leaves
+    while cur.shape[0] > 1:
+        cur = h64(cur[0::2], cur[1::2])
+        levels.append(cur)
+    return tuple(levels)
+
+
+_levels_device_jit = None
+
+
+def merkle_levels_device(leaves: np.ndarray):
+    """Push ``(w, 8)`` leaves once, compute EVERY tree level in one
+    dispatch, and return ``(root_words, device_levels)`` — the root pulled
+    immediately (32 bytes), the levels left device-resident for the caller
+    to pull lazily (the axon tunnel pulls ~11 MB/s; eager per-level pulls
+    are what made the r3 cold state root take minutes)."""
+    global _levels_device_jit
+    if _levels_device_jit is None:
+        _levels_device_jit = jax.jit(_levels_body,
+                                     static_argnames=("use_kernel",))
+    dev = jax.device_put(np.ascontiguousarray(leaves).astype(
+        np.uint32, copy=False))
+    levels = _levels_device_jit(dev, use_kernel=_use_pallas())
+    return np.asarray(levels[-1])[0], levels
+
+
 @lru_cache(maxsize=8)
 def brev_indices(chunk_log2: int) -> np.ndarray:
     """``(2^chunk_log2,) int32``: bit-reversal permutation of chunk slots.

@@ -30,7 +30,7 @@ import numpy as np
 
 from .merkle import (ZERO_HASHES, _next_pow2, hash64_host_words,
                      mix_in_length_host)
-from .sha256 import hash64, words_to_bytes
+from .sha256 import words_to_bytes
 
 # Instrumentation: number of 64-byte hash compressions performed by caches
 # (host + device), for O(changes·log n) assertions in tests.
@@ -42,10 +42,75 @@ def _h64_host(left: np.ndarray, right: np.ndarray) -> np.ndarray:
     return hash64_host_words(left, right)
 
 
-# Above this many nodes a full level re-reduce goes to the device.
-DEVICE_LEVEL_THRESHOLD = 1 << 14
+def fold_zero_cap(root_words: np.ndarray, lvl: int, depth: int,
+                  mixin_length: bool, length: int) -> bytes:
+    """Fold a subtree root at level ``lvl`` against the zero-hash table up
+    to ``depth`` + optional length mixin — a tight hashlib byte chain (the
+    array-marshalling version cost ~60 µs per level; the registry pays 20
+    of these per root at 2^20 inside a 2^40-limit list)."""
+    import hashlib
+    from .merkle import ZERO_HASHES_BYTES
+    root = words_to_bytes(np.asarray(root_words, dtype=np.uint32))
+    while lvl < depth:
+        HASH_COUNT[0] += 1
+        root = hashlib.sha256(root + ZERO_HASHES_BYTES[lvl]).digest()
+        lvl += 1
+    if mixin_length:
+        HASH_COUNT[0] += 1
+        root = mix_in_length_host(root, length)
+    return root
+
+
+# At/above this width a full (re)build runs on the attached TPU as ONE
+# dispatch with a lazy level pull.  Dirty-path walks and small rebuilds stay
+# on the host: through the axon tunnel a single device dispatch costs ~90 ms
+# round-trip and pulls run ~11 MB/s, so eager per-level device hashing (the
+# r3 design) LOSES to hashlib everywhere except the one-shot bulk build.
+DEVICE_BUILD_THRESHOLD = 1 << 17
 # Rebuild instead of walking when dirty leaves exceed this fraction.
 REBUILD_FRACTION = 8  # dirty > width/8 → rebuild
+
+
+def _tpu_attached() -> bool:
+    try:
+        from .merkle_kernel import _use_pallas
+        return _use_pallas()
+    except Exception:  # pragma: no cover
+        return False
+
+
+def start_level_pull(dev_levels) -> tuple:
+    """Spawn a background thread pulling device tree levels to host numpy.
+
+    Returns an opaque ``(thread, box)`` pending handle for
+    :func:`join_level_pull`.  Non-daemon on purpose: a daemon thread still
+    inside a jax device_get at interpreter shutdown aborts the process
+    ("FATAL: exception not rethrown"); the interpreter joining a few MB of
+    pull is the cheaper failure mode.
+    """
+    import threading
+
+    box: list = []
+
+    def pull():
+        try:
+            box.append([np.array(lv) for lv in dev_levels])
+        except Exception as e:  # pragma: no cover - tunnel hiccup
+            box.append(e)
+
+    t = threading.Thread(target=pull, daemon=False)
+    t.start()
+    return (t, box)
+
+
+def join_level_pull(pending) -> list | None:
+    """Join a :func:`start_level_pull` handle; returns the host levels or
+    None on pull failure (callers rebuild — correctness never depends on
+    the cache)."""
+    t, box = pending
+    t.join()
+    got = box[0] if box else None
+    return got if isinstance(got, list) else None
 
 
 class IncrementalMerkleCache:
@@ -55,52 +120,53 @@ class IncrementalMerkleCache:
         self.depth = max((int(limit_chunks) - 1).bit_length(), 0)
         self.mixin_length = mixin_length
         self.levels: list[np.ndarray] | None = None
+        self._pending = None  # (thread, box) while a device build pulls back
 
     # -- internals -----------------------------------------------------------
 
-    def _rebuild(self, leaves: np.ndarray) -> None:
-        """Recompute every stored level from ``leaves`` ((w, 8), w pow2)."""
+    def _rebuild(self, leaves: np.ndarray) -> np.ndarray:
+        """Recompute every stored level from ``leaves`` ((w, 8), w pow2);
+        returns the subtree root words.  Big builds run on the device in one
+        dispatch, with the interior levels pulled by a background thread
+        (the cache stays "pending" until they land)."""
         w = leaves.shape[0]
+        if w >= DEVICE_BUILD_THRESHOLD and _tpu_attached():
+            from .merkle_kernel import merkle_levels_device
+
+            HASH_COUNT[0] += w - 1
+            root, dev_levels = merkle_levels_device(leaves)
+            self.levels = None
+            self._pending = start_level_pull(dev_levels)
+            return root
         levels = [leaves]
-        use_device = False
-        try:
-            import jax
-            use_device = (w >= DEVICE_LEVEL_THRESHOLD
-                          and jax.default_backend() == "tpu")
-        except Exception:
-            pass
         cur = leaves
-        if use_device:
-            import jax.numpy as jnp
-            dev = jnp.asarray(cur)
-            while dev.shape[0] > 1:
-                HASH_COUNT[0] += dev.shape[0] // 2
-                dev = hash64(dev[0::2], dev[1::2])
-                # np.array: device pulls are read-only views; levels must
-                # stay writable for later dirty-path updates.
-                levels.append(np.array(dev))
-        else:
-            while cur.shape[0] > 1:
-                cur = _h64_host(cur[0::2], cur[1::2])
-                levels.append(cur)
+        while cur.shape[0] > 1:
+            cur = _h64_host(cur[0::2], cur[1::2])
+            levels.append(cur)
         self.levels = levels
+        return levels[-1][0]
+
+    def _finish_pending(self) -> None:
+        got = join_level_pull(self._pending)
+        self._pending = None
+        if got is not None:
+            self.levels = got
+        # else: leave levels None — the next root_words() rebuilds.
 
     def _propagate(self, dirty: np.ndarray) -> None:
-        """Recompute the ancestor paths of ``dirty`` leaf indices."""
+        """Recompute the ancestor paths of ``dirty`` leaf indices (host
+        hashlib — k·log n 64-byte hashes, µs for per-block churn)."""
         idx = np.unique(dirty >> 1)
         for lvl in range(1, len(self.levels)):
             below = self.levels[lvl - 1]
-            big = idx.size >= DEVICE_LEVEL_THRESHOLD
-            left = below[2 * idx]
-            right = below[2 * idx + 1]
-            if big:
-                import jax.numpy as jnp
-                HASH_COUNT[0] += idx.size
-                out = np.array(hash64(jnp.asarray(left), jnp.asarray(right)))
-            else:
-                out = _h64_host(left, right)
+            out = _h64_host(below[2 * idx], below[2 * idx + 1])
             self.levels[lvl][idx] = out
             idx = np.unique(idx >> 1)
+
+    def _fold_and_mix(self, root: np.ndarray, lvl: int,
+                      length: int) -> bytes:
+        return fold_zero_cap(root, lvl, self.depth, self.mixin_length,
+                             length)
 
     # -- API -----------------------------------------------------------------
 
@@ -108,33 +174,29 @@ class IncrementalMerkleCache:
         """Root over ``(k, 8)`` u32 chunk words (natural order), diffing
         against the cached copy.  Returns 32 bytes (with length mixin when
         configured)."""
+        if self._pending is not None:
+            self._finish_pending()
         k = leaves.shape[0]
         w = _next_pow2(max(k, 1))
         if leaves.dtype != np.uint32:
             leaves = leaves.astype(np.uint32)
         padded = np.zeros((w, 8), dtype=np.uint32)
         padded[:k] = leaves
+        lvl_count = w.bit_length()  # len(levels) == log2(w) + 1
         if self.levels is None or self.levels[0].shape[0] != w:
-            self._rebuild(padded)
+            root = self._rebuild(padded)
         else:
             stored = self.levels[0]
             diff = np.nonzero((stored != padded).any(axis=1))[0]
             if diff.size > w // REBUILD_FRACTION:
-                self._rebuild(padded)
-            elif diff.size:
-                stored[diff] = padded[diff]
-                self._propagate(diff)
-        root = self.levels[-1][0]
-        lvl = len(self.levels) - 1
-        while lvl < self.depth:
-            root = _h64_host(root[None], ZERO_HASHES[lvl][None])[0]
-            lvl += 1
-        root_bytes = words_to_bytes(root)
-        if self.mixin_length:
-            HASH_COUNT[0] += 1
-            root_bytes = mix_in_length_host(
-                root_bytes, int(k if length is None else length))
-        return root_bytes
+                root = self._rebuild(padded)
+            else:
+                if diff.size:
+                    stored[diff] = padded[diff]
+                    self._propagate(diff)
+                root = self.levels[-1][0]
+        return self._fold_and_mix(root, lvl_count - 1,
+                                  int(k if length is None else length))
 
     def update_rows(self, idx: np.ndarray, rows: np.ndarray,
                     count: int, length: int | None = None) -> bytes:
@@ -142,6 +204,8 @@ class IncrementalMerkleCache:
         the SOURCE level and supplies only the changed chunk rows
         (``idx`` ascending, ``rows`` (k, 8)).  ``count`` is the new total
         chunk count (must keep the same padded width)."""
+        if self._pending is not None:
+            self._finish_pending()
         if self.levels is None:
             raise ValueError("cold cache: call root_words first")
         w = self.levels[0].shape[0]
@@ -150,22 +214,16 @@ class IncrementalMerkleCache:
         if idx.size:
             self.levels[0][idx] = rows
             self._propagate(idx)
-        root = self.levels[-1][0]
-        lvl = len(self.levels) - 1
-        while lvl < self.depth:
-            root = _h64_host(root[None], ZERO_HASHES[lvl][None])[0]
-            lvl += 1
-        root_bytes = words_to_bytes(root)
-        if self.mixin_length:
-            HASH_COUNT[0] += 1
-            root_bytes = mix_in_length_host(
-                root_bytes, int(count if length is None else length))
-        return root_bytes
+        return self._fold_and_mix(self.levels[-1][0], len(self.levels) - 1,
+                                  int(count if length is None else length))
 
     def copy(self) -> "IncrementalMerkleCache":
+        if self._pending is not None:
+            self._finish_pending()
         out = IncrementalMerkleCache.__new__(IncrementalMerkleCache)
         out.depth = self.depth
         out.mixin_length = self.mixin_length
         out.levels = (None if self.levels is None
                       else [lv.copy() for lv in self.levels])
+        out._pending = None
         return out

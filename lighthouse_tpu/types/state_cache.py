@@ -33,68 +33,133 @@ from ..ops.merkle import merkleize_host
 from ..ops.tree_cache import HASH_COUNT, IncrementalMerkleCache
 
 
+# Cold builds at/above this many records run on the attached TPU in one
+# dispatch (below it the host path costs ms anyway, and Pallas wants 2^15
+# lanes).
+DEVICE_COLD_MIN = 1 << 16
+
+from ..ops.tree_cache import (_tpu_attached, join_level_pull,  # noqa: E402
+                              start_level_pull)
+
+
 class RegistryCache:
-    """Record-root cache for the SoA validator registry."""
+    """Record-root cache for the SoA validator registry.
+
+    Incremental roots are PURE HOST work — diff the columns written since
+    the last root (marks are consumed, not sticky), re-hash only the dirty
+    records, walk their ancestor paths (`tree_hash_cache.rs:535-556` role).
+    The attached-TPU dispatch round trip alone costs ~90 ms through the
+    axon tunnel, so the per-slot path never touches the device; only the
+    registry-scale cold build does (one fused dispatch), with the interior
+    levels pulled into the host tree by a background thread (tunnel pulls
+    run ~11 MB/s — ~6 s at 2^20 that the caller shouldn't block on).
+    """
 
     def __init__(self):
         self.stored: dict[str, np.ndarray] | None = None  # column copies
-        self.record_roots: np.ndarray | None = None       # (n, 8) u32
+        self.count = 0                                    # records at last root
         self.tree: IncrementalMerkleCache | None = None
+        self._pending = None                              # (thread, [levels])
+
+    # -- cold builds ---------------------------------------------------------
+
+    def _cold_host(self, reg, n: int) -> bytes:
+        self._snapshot(reg, n)
+        record_roots = np.array(reg.record_roots_words())
+        return self.tree.root_words(record_roots, length=n)
+
+    def _cold_device(self, reg, n: int) -> bytes:
+        """Fused device build: root now, host levels in the background."""
+        from .validators import registry_cold_device
+
+        self._snapshot(reg, n)
+        root_words, levels = registry_cold_device(reg)
+        self._pending = start_level_pull(levels)
+        return self._fold(root_words, len(levels) - 1, n)
+
+    def _fold(self, root_words: np.ndarray, lvl: int, n: int) -> bytes:
+        from ..ops.tree_cache import fold_zero_cap
+        return fold_zero_cap(root_words, lvl, self.tree.depth, True, n)
+
+    def _snapshot(self, reg, n: int) -> None:
+        self.stored = {c: np.array(getattr(reg, c)[:n])
+                       for c in reg._COLUMNS}
+        self.count = n
+        reg._dirty_cols.clear()
+        reg._dirty_rows.clear()
+
+    def _finish_pending(self) -> None:
+        """Join the background level pull into the host tree."""
+        got = join_level_pull(self._pending)
+        self._pending = None
+        if got is not None:
+            self.tree.levels = got
+        # On pull failure leave tree.levels unset: the next root() sees a
+        # cold tree and rebuilds (correctness never depends on the cache).
+
+    # -- the per-root entry point -------------------------------------------
 
     def root(self, reg, limit: int) -> bytes:
         n = len(reg)
         if self.tree is None:
             self.tree = IncrementalMerkleCache(limit, mixin_length=True)
-        if self.stored is None or self.record_roots is None \
-                or self.record_roots.shape[0] > n:
-            # Cold start (or shrink, which consensus never does): full
-            # build.  np.array: the device path hands back read-only views.
-            self.record_roots = np.array(reg.record_roots_words())
-            self.stored = {c: np.array(getattr(reg, c)[:n])
-                           for c in reg._COLUMNS}
-        else:
-            old_n = self.record_roots.shape[0]
-            dirty = np.zeros(n, dtype=bool)
-            dirty[old_n:] = True
-            for cname in reg._dirty_cols:
-                col = getattr(reg, cname)[:old_n]
-                st = self.stored[cname][:old_n]
-                if col.ndim == 1:
-                    np.logical_or(dirty[:old_n], col != st, out=dirty[:old_n])
-                else:
-                    np.logical_or(dirty[:old_n], (col != st).any(axis=1),
-                                  out=dirty[:old_n])
-            for r in reg._dirty_rows:
-                if r < n:
-                    dirty[r] = True
-            idx = np.nonzero(dirty)[0]
-            if idx.size:
-                roots = reg.record_roots_words(idx)
-                if n != old_n:
-                    grown = np.zeros((n, 8), dtype=np.uint32)
-                    grown[:old_n] = self.record_roots
-                    self.record_roots = grown
-                self.record_roots[idx] = roots
-                for cname in reg._COLUMNS:
-                    col = getattr(reg, cname)[:n]
-                    st = self.stored[cname]
-                    if st.shape[0] != n:
-                        st = np.array(col)
-                        self.stored[cname] = st
-                    else:
-                        st[idx] = col[idx]
-        # Row marks are consumed; column marks are sticky (a wcol view may
-        # be held and written later — the column is re-diffed every root).
+        if self._pending is not None:
+            self._finish_pending()
+        from ..ops.merkle import _next_pow2
+        cold = (self.stored is None or self.count > n
+                or self.tree.levels is None
+                or self.tree.levels[0].shape[0] != _next_pow2(max(n, 1)))
+        if cold:
+            if n >= DEVICE_COLD_MIN and _tpu_attached():
+                return self._cold_device(reg, n)
+            return self._cold_host(reg, n)
+
+        old_n = self.count
+        dirty = np.zeros(n, dtype=bool)
+        dirty[old_n:] = True
+        for cname in reg._dirty_cols:
+            col = getattr(reg, cname)[:old_n]
+            st = self.stored[cname][:old_n]
+            if col.ndim == 1:
+                np.logical_or(dirty[:old_n], col != st, out=dirty[:old_n])
+            else:
+                np.logical_or(dirty[:old_n], (col != st).any(axis=1),
+                              out=dirty[:old_n])
+        for r in reg._dirty_rows:
+            if r < n:
+                dirty[r] = True
+        # Marks are consumed: wcol views are only valid until the next
+        # root (every in-tree caller writes immediately; the sticky
+        # alternative re-diffed 130 MB of columns every slot at 2^20).
+        reg._dirty_cols.clear()
         reg._dirty_rows.clear()
-        return self.tree.root_words(self.record_roots, length=n)
+        idx = np.nonzero(dirty)[0]
+        if idx.size:
+            roots = reg.record_roots_words(idx)
+            for cname in reg._COLUMNS:
+                col = getattr(reg, cname)
+                st = self.stored[cname]
+                if st.shape[0] != n:  # grew within the same padded width
+                    grown = np.zeros((n,) + st.shape[1:], dtype=st.dtype)
+                    grown[:old_n] = st
+                    st = grown
+                    self.stored[cname] = st
+                st[idx] = col[idx]
+            self.count = n
+            return self.tree.update_rows(idx, roots, n, length=n)
+        self.count = n
+        return self.tree.update_rows(
+            np.empty(0, np.int64), np.empty((0, 8), np.uint32), n, length=n)
 
     def copy(self) -> "RegistryCache":
+        if self._pending is not None:
+            self._finish_pending()
         out = RegistryCache.__new__(RegistryCache)
         out.stored = (None if self.stored is None
                       else {k: v.copy() for k, v in self.stored.items()})
-        out.record_roots = (None if self.record_roots is None
-                            else self.record_roots.copy())
+        out.count = self.count
         out.tree = None if self.tree is None else self.tree.copy()
+        out._pending = None
         return out
 
 

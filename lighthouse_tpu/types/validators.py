@@ -100,10 +100,10 @@ class ValidatorRegistry:
         # (``cached_tree_hash``'s dirty leaves, at column/row granularity).
         # ``col()`` views are read-only so every write goes through ``wcol``/
         # ``set``/``append`` and is tracked — an unmarked write raises.
-        # ``_dirty_cols`` is STICKY: once a column has been exposed through
-        # ``wcol`` it stays marked for good, because the caller may hold the
-        # writable view across hash-cache consumptions; the cache re-diffs
-        # sticky columns every root (a vectorized compare, ~ms at 1M).
+        # Marks are CONSUMED by the hash cache at root time: a ``wcol``
+        # view is only valid for writing until the next ``hash_tree_root``
+        # (every in-tree caller writes immediately; sticky marks meant
+        # re-diffing 130 MB of columns on every root at 2^20 validators).
         self._dirty_cols: set = set(self._COLUMNS)
         self._dirty_rows: set = set()
 
@@ -129,7 +129,11 @@ class ValidatorRegistry:
     def wcol(self, name: str) -> np.ndarray:
         """Writable column view; marks the whole column dirty (the hash
         cache diffs it against its stored copy at root time, so the cost of
-        a column-wide mark is one vectorized compare, not a rehash)."""
+        a column-wide mark is one vectorized compare, not a rehash).
+
+        The view must not be written after the next ``hash_tree_root`` —
+        the cache consumes the mark there; re-call ``wcol`` for later
+        writes."""
         self._dirty_cols.add(name)
         return getattr(self, "_" + name)[:self._n]
 
@@ -321,6 +325,170 @@ def _column_property(name: str) -> property:
 for _cname in ValidatorRegistry._COLUMNS:
     setattr(ValidatorRegistry, _cname, _column_property(_cname))
 del _cname
+
+
+# ---------------------------------------------------------------------------
+# Device cold build: every registry tree level in ONE dispatch
+# ---------------------------------------------------------------------------
+#
+# The incremental state cache needs the record roots AND the interior levels
+# of the registry tree (to propagate dirty paths on the host).  Computing
+# them eagerly level-by-level bounces hundreds of MB through the axon tunnel
+# (the r3 cold path cost 559 s); host hashlib needs ~8 hashes/record ≈ 10+ s
+# at 2^20.  Instead one jitted program computes the per-record mini-trees and
+# every registry level on-device (Pallas hash64 for the wide levels), the
+# 32-byte root is pulled immediately, and the levels are pulled lazily (the
+# tunnel pulls ~11 MB/s — a background thread hides the ~6 s for 2^20).
+
+def _bswap32(x):
+    import jax.numpy as jnp
+    return (((x & np.uint32(0xFF)) << np.uint32(24))
+            | (((x >> np.uint32(8)) & np.uint32(0xFF)) << np.uint32(16))
+            | (((x >> np.uint32(16)) & np.uint32(0xFF)) << np.uint32(8))
+            | (x >> np.uint32(24)))
+
+
+def _u64_lohi_words(lohi):
+    """(n, 2) u32 little-endian (lo, hi) → (n, 8) big-endian chunk words."""
+    import jax.numpy as jnp
+    z = jnp.zeros_like(lohi[:, 0])
+    return jnp.stack([_bswap32(lohi[:, 0]), _bswap32(lohi[:, 1]),
+                      z, z, z, z, z, z], axis=-1)
+
+
+def _registry_raw_columns(reg: "ValidatorRegistry", m: int) -> dict:
+    """Host marshalling for the cold build: byte columns as words, u64
+    columns as raw (n, 2) u32 views (device expands them — 4× less tunnel
+    traffic than pushing chunk words), padded to ``m`` rows."""
+    n = reg._n
+
+    def pad(a):
+        if a.shape[0] == m:
+            return a
+        out = np.zeros((m,) + a.shape[1:], dtype=a.dtype)
+        out[:n] = a
+        return out
+
+    def lohi(col):
+        return np.ascontiguousarray(col[:n]).view(np.uint32).reshape(n, 2)
+
+    cols = {
+        "pubkey": pad(bytes_col_to_words(reg._pubkey[:n])),
+        "withdrawal_credentials": pad(
+            bytes_col_to_words(reg._withdrawal_credentials[:n])),
+        # u8 on the wire (the tunnel pushes ~43 MB/s — every byte counts);
+        # widened on-device.
+        "slashed": pad(reg._slashed[:n].astype(np.uint8)),
+    }
+    for f in ("effective_balance",) + _EPOCH_FIELDS:
+        cols[f] = pad(lohi(getattr(reg, "_" + f)))
+    return cols
+
+
+def _registry_levels_body(cols: dict, *, n: int, w: int, use_kernel: bool):
+    """Device body: raw columns (m rows) → tuple of registry tree levels.
+
+    ``levels[0]`` = (w, 8) record roots of the first ``n ≤ m`` records,
+    padded with zero CHUNKS (SSZ list semantics) to the power-of-two width
+    ``w``; ``levels[-1]`` = (1, 8) root of the w-subtree.  Rows n..m are
+    marshalling pad (Pallas needs 2^15-multiples) — their garbage mini-tree
+    roots are sliced off before the zero-chunk padding.
+    """
+    import jax.numpy as jnp
+    from ..ops.merkle_kernel import hash64_pallas
+
+    PB = 1 << 15  # hash64_pallas lane-count granularity
+
+    def h64(a, b):
+        flat_ok = a.shape[0] % PB == 0 and a.shape[0] >= PB and a.ndim == 2
+        if use_kernel and flat_ok:
+            return hash64_pallas(a, b)
+        return hash64(a, b)
+
+    pk = cols["pubkey"]                       # (m, 12) words
+    m = pk.shape[0]
+    pk_lo = pk[:, :8]
+    pk_hi = jnp.pad(pk[:, 8:], ((0, 0), (0, 4)))
+    pubkey_root = h64(pk_lo, pk_hi)
+    sl = cols["slashed"].astype(jnp.uint32)
+    z = jnp.zeros_like(sl)
+    slashed_words = jnp.stack([_bswap32(sl), z, z, z, z, z, z, z], axis=-1)
+    leaves = jnp.stack([
+        pubkey_root,
+        cols["withdrawal_credentials"],
+        _u64_lohi_words(cols["effective_balance"]),
+        slashed_words,
+        _u64_lohi_words(cols["activation_eligibility_epoch"]),
+        _u64_lohi_words(cols["activation_epoch"]),
+        _u64_lohi_words(cols["exit_epoch"]),
+        _u64_lohi_words(cols["withdrawable_epoch"]),
+    ], axis=1)                                # (m, 8, 8)
+    l1 = h64(leaves[:, 0::2].reshape(4 * m, 8),
+             leaves[:, 1::2].reshape(4 * m, 8)).reshape(m, 4, 8)
+    l2 = h64(l1[:, 0::2].reshape(2 * m, 8),
+             l1[:, 1::2].reshape(2 * m, 8)).reshape(m, 2, 8)
+    rec = h64(l2[:, 0], l2[:, 1])             # (m, 8) record roots
+    return _levels_from_records(rec, n, w, h64)
+
+
+def _levels_from_records(rec, n: int, w: int, h64):
+    """Registry levels over ``rec``: keep the first ``n`` REAL record roots
+    (rows beyond ``n`` are marshalling-pad garbage — zero-RECORD roots, not
+    zero chunks), zero-chunk pad to the power-of-two width ``w``."""
+    import jax.numpy as jnp
+    rec = rec[:n]
+    if n < w:
+        rec = jnp.concatenate(
+            [rec, jnp.zeros((w - n, 8), jnp.uint32)], axis=0)
+    levels = [rec]
+    cur = rec
+    while cur.shape[0] > 1:
+        cur = h64(cur[0::2], cur[1::2])
+        levels.append(cur)
+    return tuple(levels)
+
+
+_PALLAS_PAD = 1 << 15
+_levels_jit = None
+
+# Stage timings of the most recent cold build (ms), for bench reporting:
+# the column push through the axon tunnel (~43 MB/s measured) dominates the
+# on-device compute, and the split keeps the cold number interpretable.
+LAST_COLD_TIMINGS: dict = {}
+
+
+def registry_cold_device(reg: "ValidatorRegistry"):
+    """One-dispatch cold build on the attached TPU.
+
+    Returns ``(root_words, levels)``: ``root_words`` is the (8,) u32 root of
+    the occupied power-of-two subtree (host numpy, pulled immediately);
+    ``levels`` are the device-resident tree levels for the caller to pull
+    lazily into the host incremental cache.
+    """
+    global _levels_jit
+    import time
+    import jax
+    from ..ops.merkle import _next_pow2
+    from ..ops.merkle_kernel import _use_pallas
+
+    n = reg._n
+    w = _next_pow2(max(n, 1))
+    # Pad rows to the Pallas granularity; slice the pad off on-device.
+    m = max(-(-n // _PALLAS_PAD) * _PALLAS_PAD, _PALLAS_PAD)
+    t0 = time.perf_counter()
+    cols = {k: jax.device_put(v)
+            for k, v in _registry_raw_columns(reg, m).items()}
+    jax.block_until_ready(cols)
+    t1 = time.perf_counter()
+    if _levels_jit is None:
+        _levels_jit = jax.jit(_registry_levels_body,
+                              static_argnames=("n", "w", "use_kernel"))
+    levels = _levels_jit(cols, n=n, w=w, use_kernel=_use_pallas())
+    root_words = np.asarray(levels[-1])[0]
+    t2 = time.perf_counter()
+    LAST_COLD_TIMINGS["push_ms"] = round((t1 - t0) * 1e3, 1)
+    LAST_COLD_TIMINGS["compute_ms"] = round((t2 - t1) * 1e3, 1)
+    return root_words, levels
 
 
 # ---------------------------------------------------------------------------

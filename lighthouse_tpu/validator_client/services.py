@@ -161,11 +161,14 @@ class DoppelgangerService:
         probe = epoch - 1
         if probe < self.start_epoch or probe in self._probed:
             return  # no fully-completed watch epoch yet / already probed
-        self._probed.add(probe)
         pks = self.store.pubkeys()
         indices = [self.store.index_by_pubkey[pk] for pk in pks]
         live = self.fallback.first_success(
             lambda bn: bn.liveness(probe, indices))
+        # Only a probe that actually RAN counts toward the watch window —
+        # marking before the query would let a transient BN outage skip an
+        # epoch's check while still counting it toward release.
+        self._probed.add(probe)
         for pk, is_live in zip(pks, live):
             if is_live:
                 self.detected.add(pk)
