@@ -1,0 +1,318 @@
+"""Generate consensus-spec-test vectors from this framework's own
+executable spec, in the standard EF directory layout.
+
+This environment has no network access, so the official
+``ethereum/consensus-spec-tests`` tarballs cannot be fetched; as VERDICT r3
+prescribed for that case, these vectors are produced by OUR state
+transition + crypto (python backend) and serve as (a) regression pins,
+(b) cross-backend consistency checks (fake / tpu backends must agree), and
+(c) proof the runner infrastructure consumes the real layout — a genuine
+tarball dropped at the same root runs through the identical walker.
+
+Layout written (mirrors ``handler.rs:10-46``):
+
+    <root>/tests/minimal/<fork>/{sanity,operations,epoch_processing,
+                                 shuffling,ssz_static}/...
+    <root>/tests/general/phase0/bls/<handler>/small/<case>/data.yaml
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import yaml
+
+from ..crypto import bls as B
+from ..state_transition import per_block as PB
+from ..state_transition import signature_sets as sigs
+from ..state_transition.shuffle import shuffle_list
+from ..types.chain_spec import ChainSpec, ForkName
+from ..types.presets import MINIMAL
+from .ef_runner import _epoch_steps
+from .harness import StateHarness
+
+GEN_FORKS = (ForkName.PHASE0, ForkName.ALTAIR, ForkName.BELLATRIX,
+             ForkName.CAPELLA)
+
+
+def _write(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _write_yaml(path: str, obj) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(obj, f)
+
+
+def _case(root: str, config: str, fork: ForkName, runner: str, handler: str,
+          suite: str, case: str) -> str:
+    return os.path.join(root, "tests", config, fork.value, runner, handler,
+                        suite, case)
+
+
+def _dump_state(d: str, name: str, state) -> None:
+    _write(os.path.join(d, name + ".ssz"), type(state).serialize(state))
+
+
+def _harness(fork: ForkName) -> StateHarness:
+    return StateHarness(n_validators=16, fork=fork, preset=MINIMAL,
+                        spec=ChainSpec.minimal().with_forks_at_genesis(fork))
+
+
+def _gen_sanity(root: str, fork: ForkName) -> None:
+    h = _harness(fork)
+    h.extend_chain(3)
+    spe = h.preset.SLOTS_PER_EPOCH
+
+    # slots: single slot + across an epoch boundary
+    for case, n_slots in (("slots_1", 1), ("over_epoch", spe + 1)):
+        d = _case(root, "minimal", fork, "sanity", "slots", "pyspec_tests",
+                  case)
+        pre = h.state.copy()
+        _dump_state(d, "pre", pre)
+        from ..state_transition.per_slot import process_slots
+        post = process_slots(pre.copy(), int(pre.slot) + n_slots, h.preset,
+                             h.spec, h.T)
+        _write_yaml(os.path.join(d, "slots.yaml"), n_slots)
+        _dump_state(d, "post", post)
+
+    # blocks: valid single block; invalid (wrong state root) without post
+    d = _case(root, "minimal", fork, "sanity", "blocks", "pyspec_tests",
+              "valid_block")
+    pre = h.state.copy()
+    _dump_state(d, "pre", pre)
+    sb = h.build_block()
+    _write(os.path.join(d, "blocks_0.ssz"), type(sb).serialize(sb))
+    _write_yaml(os.path.join(d, "meta.yaml"), {"blocks_count": 1})
+    from ..state_transition.per_slot import state_transition
+    post = state_transition(pre.copy(), sb, h.preset, h.spec, h.T,
+                            strategy=PB.SignatureStrategy.VERIFY_BULK)
+    _dump_state(d, "post", post)
+
+    d = _case(root, "minimal", fork, "sanity", "blocks", "pyspec_tests",
+              "invalid_state_root")
+    _dump_state(d, "pre", h.state)
+    bad = type(sb).deserialize(type(sb).serialize(sb))
+    bad.message.state_root = b"\xba" * 32
+    _write(os.path.join(d, "blocks_0.ssz"), type(bad).serialize(bad))
+    _write_yaml(os.path.join(d, "meta.yaml"), {"blocks_count": 1})
+
+
+def _gen_operations(root: str, fork: ForkName) -> None:
+    h = _harness(fork)
+    h.extend_chain(3)
+    state = h.state
+    T = h.T
+
+    def emit(handler: str, file_name: str, op_cls, op, apply_fn,
+             case: str = "ok", expect_valid: bool = True) -> None:
+        d = _case(root, "minimal", fork, "operations", handler,
+                  "pyspec_tests", case)
+        pre = state.copy()
+        _dump_state(d, "pre", pre)
+        _write(os.path.join(d, file_name), op_cls.serialize(op))
+        post = pre.copy()
+        try:
+            apply_fn(post, op)
+        except Exception:
+            if expect_valid:
+                # A generation-time failure on an intended-valid vector is
+                # a REGRESSION — silently emitting it as expected-invalid
+                # would turn the conformance suite green on broken code.
+                raise
+            return  # intended-invalid: no post written
+        if not expect_valid:
+            raise AssertionError(
+                f"{handler}/{case}: intended-invalid op applied cleanly")
+        _dump_state(d, "post", post)
+
+    def bulk(fn, *args):
+        acc = PB.SigAccumulator(PB.SignatureStrategy.VERIFY_BULK)
+        fn(*args, acc, sigs.PubkeyCache())
+        acc.finish()
+
+    atts = h.attestations_for_slot(state, int(state.slot) - 1)
+    emit("attestation", "attestation.ssz", T.Attestation, atts[0],
+         lambda s, op: bulk(PB.process_attestation, s, op, fork, h.preset,
+                            h.spec, T))
+    emit("proposer_slashing", "proposer_slashing.ssz", T.ProposerSlashing,
+         h.make_proposer_slashing(state, 3),
+         lambda s, op: bulk(PB.process_proposer_slashing, s, op, fork,
+                            h.preset, h.spec))
+    emit("attester_slashing", "attester_slashing.ssz", T.AttesterSlashing,
+         h.make_attester_slashing(state, [4, 5]),
+         lambda s, op: bulk(PB.process_attester_slashing, s, op, fork,
+                            h.preset, h.spec))
+    # voluntary exit requires the shard-committee-period wait on a fresh
+    # chain → this is the expected-invalid case (no post file).
+    emit("voluntary_exit", "voluntary_exit.ssz", T.SignedVoluntaryExit,
+         h.make_exit(state, 6),
+         lambda s, op: bulk(PB.process_voluntary_exit, s, op, fork,
+                            h.preset, h.spec), case="too_early",
+         expect_valid=False)
+    if fork >= ForkName.ALTAIR:
+        agg = h.sync_aggregate_for(state, int(state.slot))
+        emit("sync_aggregate", "sync_aggregate.ssz", T.SyncAggregate, agg,
+             lambda s, op: (lambda acc: (PB.process_sync_aggregate(
+                 s, op, h.preset, h.spec, T, acc), acc.finish()))(
+                 PB.SigAccumulator(PB.SignatureStrategy.VERIFY_BULK)))
+    if fork >= ForkName.CAPELLA:
+        emit("bls_to_execution_change", "address_change.ssz",
+             T.SignedBLSToExecutionChange,
+             h.make_bls_to_execution_change(7),
+             lambda s, op: (lambda acc: (PB.process_bls_to_execution_change(
+                 s, op, h.spec, acc), acc.finish()))(
+                 PB.SigAccumulator(PB.SignatureStrategy.VERIFY_BULK)))
+
+
+def _gen_epoch_processing(root: str, fork: ForkName) -> None:
+    h = _harness(fork)
+    spe = h.preset.SLOTS_PER_EPOCH
+    h.extend_chain(2 * spe)  # into epoch 2 with real participation
+    from ..state_transition.per_slot import process_slots
+    # advance to the last slot of the epoch (epoch processing is next)
+    state = h.state.copy()
+    target = (int(state.slot) // spe + 1) * spe - 1
+    if int(state.slot) < target:
+        state = process_slots(state, target, h.preset, h.spec, h.T)
+    steps = _epoch_steps(fork, h.preset, h.spec, h.T)
+    cur = state
+    for handler, fn in steps.items():
+        d = _case(root, "minimal", fork, "epoch_processing", handler,
+                  "pyspec_tests", "from_chain")
+        _dump_state(d, "pre", cur)
+        nxt = cur.copy()
+        fn(nxt)
+        _dump_state(d, "post", nxt)
+        cur = nxt  # EF semantics: each step's pre has prior steps applied
+
+
+def _gen_ssz_static(root: str, fork: ForkName) -> None:
+    h = _harness(fork)
+    h.extend_chain(2)
+    T = h.T
+    sb = h.build_block()
+    values = {
+        "BeaconState": (T.state_cls(fork), h.state),
+        "SignedBeaconBlock": (type(sb), sb),
+        "BeaconBlock": (T.block_cls(fork), sb.message),
+        "Attestation": (T.Attestation,
+                        h.attestations_for_slot(h.state,
+                                                int(h.state.slot) - 1)[0]),
+        "Checkpoint": (T.Checkpoint, h.state.finalized_checkpoint),
+        "Validator": (None, None),  # filled below
+        "Fork": (T.Fork, h.state.fork),
+        "BeaconBlockHeader": (T.BeaconBlockHeader,
+                              h.state.latest_block_header),
+    }
+    from ..types.validators import Validator
+    values["Validator"] = (Validator, h.state.validators[0])
+    for name, (cls, value) in values.items():
+        d = _case(root, "minimal", fork, "ssz_static", name, "ssz_minimal",
+                  "case_0")
+        enc = cls.serialize(value)
+        _write(os.path.join(d, "serialized.ssz"), enc)
+        _write_yaml(os.path.join(d, "roots.yaml"),
+                    {"root": "0x" + cls.hash_tree_root(value).hex()})
+
+
+def _gen_shuffling(root: str, fork: ForkName) -> None:
+    if fork != ForkName.PHASE0:
+        return
+    for i, count in enumerate((1, 7, 64)):
+        seed = bytes([i]) * 32
+        mapping = shuffle_list(np.arange(count, dtype=np.uint64), seed,
+                               MINIMAL.SHUFFLE_ROUND_COUNT)
+        d = _case(root, "minimal", fork, "shuffling", "core", "shuffle",
+                  f"shuffle_0x{seed[:2].hex()}_{count}")
+        _write_yaml(os.path.join(d, "mapping.yaml"), {
+            "seed": "0x" + seed.hex(),
+            "count": count,
+            "mapping": [int(x) for x in mapping],
+        })
+
+
+def _gen_bls(root: str) -> None:
+    fork = ForkName.PHASE0
+
+    def case(handler: str, name: str, inp, out) -> None:
+        d = _case(root, "general", fork, "bls", handler, "small", name)
+        _write_yaml(os.path.join(d, "data.yaml"),
+                    {"input": inp, "output": out})
+
+    sks = [B.SecretKey(i + 1) for i in range(4)]
+    pks = [sk.public_key() for sk in sks]
+    msgs = [bytes([i]) * 32 for i in range(4)]
+    sigs = [sk.sign(m) for sk, m in zip(sks, msgs)]
+
+    def hx(b: bytes) -> str:
+        return "0x" + b.hex()
+
+    case("sign", "sign_case_0",
+         {"privkey": hx(sks[0].serialize()), "message": hx(msgs[0])},
+         hx(sigs[0].serialize()))
+    case("verify", "verify_valid",
+         {"pubkey": hx(pks[0].serialize()), "message": hx(msgs[0]),
+          "signature": hx(sigs[0].serialize())}, True)
+    case("verify", "verify_wrong_message",
+         {"pubkey": hx(pks[0].serialize()), "message": hx(msgs[1]),
+          "signature": hx(sigs[0].serialize())}, False)
+    case("verify", "verify_infinity_pubkey",
+         {"pubkey": hx(b"\xc0" + b"\x00" * 47), "message": hx(msgs[0]),
+          "signature": hx(sigs[0].serialize())}, False)
+    agg = B.aggregate_signatures(sigs)
+    case("aggregate", "aggregate_4",
+         [hx(s.serialize()) for s in sigs], hx(agg.serialize()))
+    case("aggregate_verify", "aggregate_verify_valid",
+         {"pubkeys": [hx(p.serialize()) for p in pks],
+          "messages": [hx(m) for m in msgs],
+          "signature": hx(agg.serialize())}, True)
+    case("aggregate_verify", "aggregate_verify_tampered",
+         {"pubkeys": [hx(p.serialize()) for p in pks],
+          "messages": [hx(m) for m in reversed(msgs)],
+          "signature": hx(agg.serialize())}, False)
+    same = [sk.sign(msgs[0]) for sk in sks]
+    fagg = B.aggregate_signatures(same)
+    case("fast_aggregate_verify", "fast_valid",
+         {"pubkeys": [hx(p.serialize()) for p in pks],
+          "message": hx(msgs[0]), "signature": hx(fagg.serialize())}, True)
+    case("fast_aggregate_verify", "fast_no_pubkeys",
+         {"pubkeys": [], "message": hx(msgs[0]),
+          "signature": hx(b"\xc0" + b"\x00" * 95)}, False)
+    from ..crypto import curve as C
+    agg_pk = B.aggregate_public_keys(pks)
+    case("eth_aggregate_pubkeys", "aggregate_pubkeys_4",
+         [hx(p.serialize()) for p in pks], hx(C.g1_compress(agg_pk)))
+    case("batch_verify", "batch_valid",
+         {"pubkeys": [hx(p.serialize()) for p in pks],
+          "messages": [hx(m) for m in msgs],
+          "signatures": [hx(s.serialize()) for s in sigs]}, True)
+    case("batch_verify", "batch_one_bad",
+         {"pubkeys": [hx(p.serialize()) for p in pks],
+          "messages": [hx(m) for m in msgs],
+          "signatures": [hx(s.serialize())
+                         for s in [sigs[1]] + sigs[1:]]}, False)
+
+
+def generate(root: str) -> None:
+    """Write the full tree under ``root`` (idempotent: wipes first)."""
+    import shutil
+    tests = os.path.join(root, "tests")
+    if os.path.isdir(tests):
+        shutil.rmtree(tests)
+    prev = B.get_backend().name
+    B.set_backend("python")
+    try:
+        for fork in GEN_FORKS:
+            _gen_sanity(root, fork)
+            _gen_operations(root, fork)
+            _gen_epoch_processing(root, fork)
+            _gen_ssz_static(root, fork)
+            _gen_shuffling(root, fork)
+        _gen_bls(root)
+    finally:
+        B.set_backend(prev)
