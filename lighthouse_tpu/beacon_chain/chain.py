@@ -65,10 +65,12 @@ class BeaconChain:
         self.observed_aggregators = ObservedAggregators()
         self.observed_block_producers = ObservedBlockProducers()
         self.payload_verifier = None  # execution-layer seam
+        self.genesis_block_root = genesis_block_root
         self.fork_choice = ForkChoice(
             preset, spec, genesis_root=genesis_block_root,
             genesis_state=genesis_state.copy())
         genesis_state_root = genesis_state.tree_hash_root()
+        self.genesis_state_root = genesis_state_root
         store.put_state(genesis_state_root, genesis_state.copy(),
                         genesis_block_root)
         self._states_by_block: dict[bytes, object] = {
@@ -77,6 +79,85 @@ class BeaconChain:
         self.head = CanonicalHead(root=genesis_block_root,
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
+
+    # -- restart persistence -------------------------------------------------
+
+    def persist(self) -> None:
+        """Persist fork choice + op pool + chain metadata so a restarted
+        process resumes with the identical head and pending operations
+        (`persisted_fork_choice.rs`, `operation_pool/src/persistence.rs`,
+        `persisted_beacon_chain.rs`)."""
+        from ..fork_choice.persistence import encode_fork_choice
+        from ..op_pool.persistence import encode_op_pool
+        self.store.kv.do_atomically([
+            ("put", DBColumn.ForkChoice, b"fork_choice",
+             encode_fork_choice(self.fork_choice)),
+            ("put", DBColumn.OpPool, b"op_pool",
+             encode_op_pool(self.op_pool, self.T)),
+            ("put", DBColumn.BeaconChain, b"genesis",
+             self.genesis_block_root + self.genesis_state_root),
+        ])
+
+    @classmethod
+    def resume(cls, *, store: HotColdDB, preset, spec, T, slot_clock=None):
+        """Rebuild a chain from a persisted store (restart path — the
+        `ClientBuilder.build_beacon_chain` resume branch,
+        `client/src/builder.rs:850`)."""
+        from ..fork_choice.persistence import decode_fork_choice
+        from ..op_pool.persistence import decode_op_pool
+
+        meta = store.get_item(DBColumn.BeaconChain, b"genesis")
+        fc_blob = store.get_item(DBColumn.ForkChoice, b"fork_choice")
+        pool_blob = store.get_item(DBColumn.OpPool, b"op_pool")
+        if meta is None or fc_blob is None:
+            raise BlockError("store holds no persisted chain")
+        genesis_root, genesis_state_root = meta[:32], meta[32:64]
+        fc = decode_fork_choice(fc_blob, preset=preset, spec=spec,
+                                justified_state=None)
+
+        def _post_state_of(block_root: bytes):
+            if block_root == genesis_root:
+                return store.get_state(genesis_state_root)
+            block = store.get_block(block_root)
+            if block is None:
+                return None
+            return store.get_state(bytes(block.message.state_root))
+
+        jstate = _post_state_of(fc.justified_checkpoint[1])
+        if jstate is None:
+            raise BlockError("justified state missing from store")
+        fc.justified_state = jstate
+
+        chain = cls.__new__(cls)
+        chain.store = store
+        chain.preset = preset
+        chain.spec = spec
+        chain.T = T
+        chain.slot_clock = slot_clock
+        chain.pubkey_cache = sigs.PubkeyCache()
+        chain.op_pool = (decode_op_pool(pool_blob, preset, spec, T)
+                         if pool_blob is not None
+                         else OperationPool(preset, spec))
+        chain.observed_attesters = ObservedAttesters()
+        chain.observed_aggregators = ObservedAggregators()
+        chain.observed_block_producers = ObservedBlockProducers()
+        chain.payload_verifier = None
+        chain.genesis_block_root = genesis_root
+        chain.genesis_state_root = genesis_state_root
+        chain.fork_choice = fc
+        chain._states_by_block = {}
+        chain._advanced_states = {}
+        head_root = fc.get_head()
+        head_state = _post_state_of(head_root)
+        if head_state is None:
+            raise BlockError("head state missing from store")
+        chain._states_by_block[head_root] = head_state.copy()
+        # Post-state slot == block slot (and covers a genesis head, which
+        # has no stored block).
+        chain.head = CanonicalHead(root=head_root,
+                                   slot=int(head_state.slot),
+                                   state=head_state)
+        return chain
 
     # -- time ----------------------------------------------------------------
 

@@ -103,9 +103,22 @@ def cmd_beacon_node(args) -> int:
     store = (HotColdDB(SqliteStore(args.datadir + "/beacon.sqlite"),
                        h.preset, h.spec, h.T) if args.datadir
              else HotColdDB.memory(h.preset, h.spec, h.T))
-    chain = BeaconChain(store=store, genesis_state=h.state.copy(),
-                        genesis_block_root=hdr.tree_hash_root(),
-                        preset=h.preset, spec=h.spec, T=h.T)
+    # Resume from a previous run's persisted chain when the datadir holds
+    # one (`ClientBuilder.build_beacon_chain` resume branch); otherwise
+    # boot from interop genesis.
+    chain = None
+    if args.datadir:
+        try:
+            chain = BeaconChain.resume(store=store, preset=h.preset,
+                                       spec=h.spec, T=h.T)
+            print(f"resumed chain at slot {chain.head.slot} "
+                  f"head={chain.head.root.hex()[:12]}")
+        except Exception:
+            chain = None
+    if chain is None:
+        chain = BeaconChain(store=store, genesis_state=h.state.copy(),
+                            genesis_block_root=hdr.tree_hash_root(),
+                            preset=h.preset, spec=h.spec, T=h.T)
     api = HttpApiServer(chain, port=args.http_port)
     api.start()
     print(f"beacon node up: http://127.0.0.1:{api.port} "
@@ -116,9 +129,14 @@ def cmd_beacon_node(args) -> int:
         for i in range(args.validators):
             vstore.add_validator(interop_secret_key(i), index=i)
         vc = ValidatorClient(vstore, [InProcessBeaconNode(chain)], h.preset)
-    clock = SystemTimeSlotClock(genesis_time=int(time.time()),
-                                seconds_per_slot=args.seconds_per_slot)
-    last = 0
+    # Devnet clock: start at the next slot AFTER the (possibly resumed)
+    # head — restarting at slot 0 against a resumed head would have the VC
+    # proposing slot-1 blocks onto a later state.
+    clock = SystemTimeSlotClock(
+        genesis_time=int(time.time())
+        - chain.head.slot * args.seconds_per_slot,
+        seconds_per_slot=args.seconds_per_slot)
+    last = chain.head.slot
     try:
         deadline = (time.time() + args.run_for) if args.run_for else None
         while deadline is None or time.time() < deadline:
@@ -133,6 +151,9 @@ def cmd_beacon_node(args) -> int:
             time.sleep(0.1)
     except KeyboardInterrupt:
         pass
+    finally:
+        if args.datadir:
+            chain.persist()  # graceful-shutdown persistence
     api.stop()
     return 0
 

@@ -136,15 +136,28 @@ class NetworkNode:
         out.reverse()
         return out
 
+    def head_slot(self) -> int:
+        """Peer-handle protocol (shared with the wire transport's
+        :class:`~.transport.RemotePeer`)."""
+        return self.chain.head.slot
+
     def _range_sync(self, target_slot: int) -> bool:
         """Minimal `range_sync`: pull the missing span from the first peer
         ahead of us and import as a chain segment."""
         start = self.chain.head.slot + 1
         for peer in self.peers:
-            if peer.chain.head.slot < start:
+            try:
+                if peer.head_slot() < start:
+                    continue
+                blocks = peer.blocks_by_range(BlocksByRangeRequest(
+                    start_slot=start, count=max(target_slot - start + 1, 1)))
+            except Exception as e:
+                # A stalled/dead wire peer (Req/Resp timeout, reset socket)
+                # must not abort the sync loop — try the next peer
+                # (`range_sync` peer scoring/rotation role).
+                self.log.warn("range-sync peer failed", peer=str(peer),
+                              reason=type(e).__name__)
                 continue
-            blocks = peer.blocks_by_range(BlocksByRangeRequest(
-                start_slot=start, count=max(target_slot - start, 1)))
             ok = False
             for b in blocks:
                 try:

@@ -1,0 +1,341 @@
+"""Wire transport: SSZ-framed TCP gossip + Req/Resp between OS processes.
+
+The reference's internet stack is libp2p — gossipsub meshes, SSZ-snappy
+Req/Resp streams, discv5 discovery
+(``/root/reference/beacon_node/lighthouse_network/src/rpc/protocol.rs:161-179``).
+This module is the first real wire behind this framework's in-process
+seams: a :class:`WireNetwork` owns a TCP listener, speaks length-prefixed
+SSZ frames (snappy is not available in this environment; the framing layer
+is a strict subset of SSZ-snappy minus compression), floods gossip to
+every connected peer with seen-message dedup, and serves/issues
+``Status`` + ``BlocksByRange`` Req/Resp — enough for two processes to find
+each other's head and range-sync, the ``testing/simulator`` seed.
+
+Frame layout (all integers little-endian):
+
+    u8 kind | u32 len | payload
+    kind 0 GOSSIP:  u8 topic_len | topic | body
+    kind 1 REQUEST: u32 req_id | u8 method | body
+    kind 2 RESPONSE:u32 req_id | body
+
+Gossip bodies carry a fork-id byte before each SSZ container so the
+receiver picks the right per-fork class (the role of the reference's
+ForkDigest in topic names).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..types.chain_spec import ForkName
+from .service import (
+    BlocksByRangeRequest,
+    GossipBus,
+    NetworkNode,
+    TOPIC_AGGREGATE,
+    TOPIC_BLOCK,
+)
+
+_FORK_IDS = {f: i for i, f in enumerate(ForkName)}
+_FORK_BY_ID = {i: f for f, i in _FORK_IDS.items()}
+
+KIND_GOSSIP = 0
+KIND_REQUEST = 1
+KIND_RESPONSE = 2
+
+METHOD_STATUS = 0
+METHOD_BLOCKS_BY_RANGE = 1
+
+
+def _enc_block(T, signed_block) -> bytes:
+    fork = T.fork_of_block(signed_block.message)
+    return bytes([_FORK_IDS[fork]]) + type(signed_block).serialize(
+        signed_block)
+
+
+def _dec_block(T, data: bytes):
+    fork = _FORK_BY_ID[data[0]]
+    return T.signed_block_cls(fork).deserialize(data[1:])
+
+
+def _enc_atts(T, atts: List) -> bytes:
+    out = [struct.pack("<I", len(atts))]
+    for a in atts:
+        enc = T.Attestation.serialize(a)
+        out.append(struct.pack("<I", len(enc)))
+        out.append(enc)
+    return b"".join(out)
+
+
+def _dec_atts(T, data: bytes) -> List:
+    (n,) = struct.unpack_from("<I", data, 0)
+    off = 4
+    atts = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        atts.append(T.Attestation.deserialize(data[off:off + ln]))
+        off += ln
+    return atts
+
+
+class _Conn:
+    """One framed TCP connection with a reader thread."""
+
+    def __init__(self, sock: socket.socket, on_frame, on_close):
+        self.sock = sock
+        self._wlock = threading.Lock()
+        self._on_frame = on_frame
+        self._on_close = on_close
+        self._t = threading.Thread(target=self._reader, daemon=True)
+        self._t.start()
+
+    def send(self, kind: int, payload: bytes) -> None:
+        frame = struct.pack("<BI", kind, len(payload)) + payload
+        with self._wlock:
+            self.sock.sendall(frame)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _reader(self) -> None:
+        try:
+            while True:
+                hdr = self._recv_exact(5)
+                if hdr is None:
+                    break
+                kind, ln = struct.unpack("<BI", hdr)
+                payload = self._recv_exact(ln)
+                if payload is None:
+                    break
+                self._on_frame(self, kind, payload)
+        except Exception:
+            # Malformed frames (bad fork id, truncated SSZ, unknown
+            # method) disconnect the peer — a remote can always send
+            # garbage; it must never wedge the reader silently with the
+            # socket left open.
+            pass
+        finally:
+            self.close()
+            self._on_close(self)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RemotePeer:
+    """Peer handle over a connection — the NetworkNode sync protocol
+    (``head_slot()`` + ``blocks_by_range()``) backed by Req/Resp."""
+
+    def __init__(self, net: "WireNetwork", conn: _Conn):
+        self._net = net
+        self._conn = conn
+        self.status_head_slot = 0
+
+    def head_slot(self) -> int:
+        # Refresh via a Status round-trip (`rpc` Status; the reference
+        # also re-STATUSes before sync decisions).
+        try:
+            resp = self._net._request(self._conn, METHOD_STATUS, b"")
+            (self.status_head_slot,) = struct.unpack("<Q", resp[:8])
+        except Exception:
+            pass
+        return self.status_head_slot
+
+    def blocks_by_range(self, req: BlocksByRangeRequest) -> List:
+        body = struct.pack("<QQ", req.start_slot, req.count)
+        resp = self._net._request(self._conn, METHOD_BLOCKS_BY_RANGE, body)
+        (n,) = struct.unpack_from("<I", resp, 0)
+        off = 4
+        out = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", resp, off)
+            off += 4
+            out.append(_dec_block(self._net.T, resp[off:off + ln]))
+            off += ln
+        return out
+
+
+class WireNetwork:
+    """TCP gossip + Req/Resp endpoint wrapping a :class:`NetworkNode`.
+
+    Construction starts a listener on ``port`` (0 = ephemeral); ``dial``
+    connects out.  All connected peers receive published gossip; incoming
+    gossip floods onward (seen-hash dedup) and feeds the local node's
+    BeaconProcessor exactly like in-process gossip.
+    """
+
+    def __init__(self, chain, name: str = "node", port: int = 0,
+                 log=None):
+        self.T = chain.T
+        self.bus = GossipBus()
+        self.node = NetworkNode(chain, self.bus, name=name, log=log)
+        self._conns: List[_Conn] = []
+        self._peers: Dict[_Conn, RemotePeer] = {}
+        self._pending: Dict[int, threading.Event] = {}
+        self._responses: Dict[int, bytes] = {}
+        self._req_id = 0
+        self._seen: set[bytes] = set()
+        self._lock = threading.Lock()
+        # Outbound gossip: re-publish local publishes onto the wire.
+        self.bus.subscribe(TOPIC_BLOCK, self._wire_block_out)
+        self.bus.subscribe(TOPIC_AGGREGATE, self._wire_atts_out)
+        self._listener = socket.create_server(("127.0.0.1", port))
+        self.port = self._listener.getsockname()[1]
+        self._accept_t = threading.Thread(target=self._accept_loop,
+                                          daemon=True)
+        self._accept_t.start()
+
+    # -- connections ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            self._add_conn(sock)
+
+    def _add_conn(self, sock: socket.socket) -> RemotePeer:
+        conn = _Conn(sock, self._on_frame, self._on_close)
+        peer = RemotePeer(self, conn)
+        with self._lock:
+            self._conns.append(conn)
+            self._peers[conn] = peer
+        self.node.peers.append(peer)
+        return peer
+
+    def dial(self, port: int, host: str = "127.0.0.1") -> RemotePeer:
+        sock = socket.create_connection((host, port))
+        return self._add_conn(sock)
+
+    def close(self) -> None:
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            c.close()
+
+    def _on_close(self, conn: _Conn) -> None:
+        with self._lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+            peer = self._peers.pop(conn, None)
+        if peer is not None and peer in self.node.peers:
+            self.node.peers.remove(peer)
+
+    # -- gossip --------------------------------------------------------------
+
+    def _wire_block_out(self, signed_block) -> None:
+        self._flood(TOPIC_BLOCK, _enc_block(self.T, signed_block))
+
+    def _wire_atts_out(self, atts) -> None:
+        self._flood(TOPIC_AGGREGATE, _enc_atts(self.T, atts))
+
+    def _flood(self, topic: str, body: bytes,
+               exclude: Optional[_Conn] = None) -> bool:
+        """Forward to peers unless already seen; returns True iff the
+        message was FRESH (callers gate local delivery on this — gossipsub
+        delivers each message id once)."""
+        digest = hashlib.sha256(body).digest()
+        with self._lock:
+            if digest in self._seen:
+                return False
+            self._seen.add(digest)
+            if len(self._seen) > (1 << 16):
+                self._seen.clear()
+            conns = list(self._conns)
+        t = topic.encode()
+        payload = bytes([len(t)]) + t + body
+        for c in conns:
+            if c is exclude:
+                continue
+            try:
+                c.send(KIND_GOSSIP, payload)
+            except OSError:
+                pass
+        return True
+
+    # -- frames --------------------------------------------------------------
+
+    def _on_frame(self, conn: _Conn, kind: int, payload: bytes) -> None:
+        if kind == KIND_GOSSIP:
+            tlen = payload[0]
+            topic = payload[1:1 + tlen].decode()
+            body = payload[1 + tlen:]
+            if not self._flood(topic, body, exclude=conn):
+                return  # duplicate: neither re-forward nor re-deliver
+            if topic == TOPIC_BLOCK:
+                self.node._on_gossip_block(_dec_block(self.T, body))
+            elif topic == TOPIC_AGGREGATE:
+                self.node._on_gossip_attestation(_dec_atts(self.T, body))
+        elif kind == KIND_REQUEST:
+            (req_id,) = struct.unpack_from("<I", payload, 0)
+            method = payload[4]
+            body = payload[5:]
+            resp = self._serve(method, body)
+            conn.send(KIND_RESPONSE, struct.pack("<I", req_id) + resp)
+        elif kind == KIND_RESPONSE:
+            (req_id,) = struct.unpack_from("<I", payload, 0)
+            with self._lock:
+                ev = self._pending.get(req_id)
+                if ev is None:
+                    return  # requester timed out — drop, don't leak
+                self._responses[req_id] = payload[4:]
+            ev.set()
+
+    def _serve(self, method: int, body: bytes) -> bytes:
+        if method == METHOD_STATUS:
+            return struct.pack("<Q32s", self.node.chain.head.slot,
+                               self.node.chain.head.root)
+        if method == METHOD_BLOCKS_BY_RANGE:
+            start, count = struct.unpack("<QQ", body)
+            blocks = self.node.blocks_by_range(
+                BlocksByRangeRequest(start_slot=start, count=count))
+            out = [struct.pack("<I", len(blocks))]
+            for b in blocks:
+                enc = _enc_block(self.T, b)
+                out.append(struct.pack("<I", len(enc)))
+                out.append(enc)
+            return b"".join(out)
+        raise ValueError(f"unknown method {method}")
+
+    def _request(self, conn: _Conn, method: int, body: bytes,
+                 timeout: float = 10.0) -> bytes:
+        with self._lock:
+            self._req_id += 1
+            req_id = self._req_id
+            ev = threading.Event()
+            self._pending[req_id] = ev
+        conn.send(KIND_REQUEST,
+                  struct.pack("<I", req_id) + bytes([method]) + body)
+        if not ev.wait(timeout):
+            with self._lock:
+                self._pending.pop(req_id, None)
+                self._responses.pop(req_id, None)
+            raise TimeoutError("req/resp timeout")
+        with self._lock:
+            self._pending.pop(req_id, None)
+            return self._responses.pop(req_id)
+
+    # -- convenience ---------------------------------------------------------
+
+    def publish_block(self, signed_block) -> None:
+        self.node.publish_block(signed_block)
+
+    def publish_attestations(self, atts: List) -> None:
+        self.node.publish_attestations(atts)

@@ -97,15 +97,16 @@ class OperationPool:
         # Freshness is per-epoch: an attestation for epoch E only rewards
         # validators not yet credited in E's participation flags
         # (current vs previous — mixing them mis-weights boundary packing).
-        seen_cur: set[int] = set()
-        seen_prev: set[int] = set()
+        n_vals = balances.shape[0]
+        seen_cur = np.zeros(n_vals, bool)
+        seen_prev = np.zeros(n_vals, bool)
         if hasattr(state, "current_epoch_participation"):
             cur_part = np.asarray(state.current_epoch_participation)
             if cur_part.size:
-                seen_cur.update(np.nonzero(cur_part)[0].tolist())
+                seen_cur[:cur_part.shape[0]] = cur_part != 0
             prev_part = np.asarray(state.previous_epoch_participation)
             if prev_part.size:
-                seen_prev.update(np.nonzero(prev_part)[0].tolist())
+                seen_prev[:prev_part.shape[0]] = prev_part != 0
         # else: phase0 — no participation flags; credited attesters live in
         # state.{previous,current}_epoch_attestations whose bits→index
         # resolution needs the committee shuffle, so every attester counts
@@ -121,27 +122,31 @@ class OperationPool:
 
         want_cur = _cp_key(state.current_justified_checkpoint)
         want_prev = _cp_key(state.previous_justified_checkpoint)
-        candidates = []
+        covers = []
         for entry in self.attestations.values():
+            if not entry:
+                continue
+            # Every aggregate in a group shares the same AttestationData
+            # (the dict key is its root) — filter once per group.
+            data = entry[0].data
+            att_slot = int(data.slot)
+            att_epoch = att_slot // self.preset.SLOTS_PER_EPOCH
+            if att_slot + self.preset.MIN_ATTESTATION_INCLUSION_DELAY > slot:
+                continue
+            if att_epoch not in (epoch, epoch - 1):
+                continue
+            want = want_cur if att_epoch == epoch else want_prev
+            if _cp_key(data.source) != want:
+                continue
+            seen = seen_cur if att_epoch == epoch else seen_prev
             for stored in entry:
-                att_slot = int(stored.data.slot)
-                att_epoch = att_slot // self.preset.SLOTS_PER_EPOCH
-                if att_slot + self.preset.MIN_ATTESTATION_INCLUSION_DELAY > slot:
-                    continue
-                if att_epoch not in (epoch, epoch - 1):
-                    continue
-                want = want_cur if att_epoch == epoch else want_prev
-                if _cp_key(stored.data.source) != want:
-                    continue
-                seen = seen_cur if att_epoch == epoch else seen_prev
-                idx = stored.committee[stored.bits[:len(stored.committee)]]
-                fresh = np.asarray([i for i in idx if int(i) not in seen],
-                                   dtype=np.int64)
+                idx = np.asarray(
+                    stored.committee[stored.bits[:len(stored.committee)]],
+                    dtype=np.int64)
+                fresh = idx[~seen[idx]]
                 if fresh.size == 0:
                     continue
-                candidates.append((stored, AttMaxCover(stored, fresh,
-                                                       balances)))
-        covers = [c for _, c in candidates]
+                covers.append(AttMaxCover(stored, fresh, balances))
         chosen = maximum_cover(covers, self.preset.MAX_ATTESTATIONS)
         return [self._to_attestation(c.att, T) for c in chosen]
 

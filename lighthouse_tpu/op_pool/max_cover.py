@@ -27,19 +27,46 @@ class MaxCoverItem(Protocol):
 
 def maximum_cover(items: List, limit: int) -> List:
     """Pick ≤ ``limit`` items maximising total covered weight
-    (`max_cover.rs` ``maximum_cover()``)."""
-    candidates = [it for it in items if sum(it.covering_set().values()) > 0]
+    (`max_cover.rs` ``maximum_cover()``).
+
+    Weights are cached and only re-summed for candidates whose covering
+    set intersects the round's winner (tracked via an element → candidates
+    reverse index) — the naive re-sum-everything loop made 100k-candidate
+    packing (BASELINE row 5) take seconds.  Ties break toward the earliest
+    item, matching the original first-maximal scan.
+    """
+    import heapq
+
+    weights = [sum(it.covering_set().values()) for it in items]
+    by_elem: Dict[Hashable, List[int]] = {}
+    for i, it in enumerate(items):
+        for e in it.covering_set():
+            by_elem.setdefault(e, []).append(i)
+    alive = {i for i, w in enumerate(weights) if w > 0}
+    # Lazy-deletion heap: stale entries (weight changed since push) are
+    # skipped on pop.  (-w, i) ordering pops the heaviest candidate with
+    # earliest-index tie-break, matching the original first-maximal scan.
+    heap = [(-w, i) for i, w in enumerate(weights) if w > 0]
+    heapq.heapify(heap)
     chosen: List = []
-    while candidates and len(chosen) < limit:
-        best = max(candidates,
-                   key=lambda it: sum(it.covering_set().values()))
-        if sum(best.covering_set().values()) == 0:
-            break
-        covered = dict(best.covering_set())
-        chosen.append(best)
-        candidates.remove(best)
-        for it in candidates:
-            it.update_covering_set(covered)
-        candidates = [it for it in candidates
-                      if sum(it.covering_set().values()) > 0]
+    while heap and len(chosen) < limit:
+        neg_w, best = heapq.heappop(heap)
+        if best not in alive or -neg_w != weights[best]:
+            continue  # removed or stale
+        covered = dict(items[best].covering_set())
+        chosen.append(items[best])
+        alive.remove(best)
+        touched = set()
+        for e in covered:
+            for i in by_elem.get(e, ()):
+                if i in alive:
+                    touched.add(i)
+        for i in touched:
+            items[i].update_covering_set(covered)
+            w = sum(items[i].covering_set().values())
+            weights[i] = w
+            if w == 0:
+                alive.remove(i)
+            else:
+                heapq.heappush(heap, (-w, i))
     return chosen

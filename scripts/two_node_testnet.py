@@ -1,0 +1,122 @@
+"""Two-process localhost testnet over the wire transport.
+
+The seed of the reference's ``testing/simulator``: process A runs a chain
+with a validator set and publishes blocks over TCP gossip; process B joins
+late with only the genesis state, range-syncs over Req/Resp, then follows
+gossip.  Run with no arguments — the script forks itself.
+
+    python scripts/two_node_testnet.py
+
+Exit code 0 iff node B converges to node A's head.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+SLOTS = 8
+
+
+def _make_chain():
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls as B
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    B.set_backend("fake")
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=hdr.tree_hash_root(),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    return h, chain
+
+
+def node_a(port_file: str) -> int:
+    from lighthouse_tpu.network.transport import WireNetwork
+
+    h, chain = _make_chain()
+    net = WireNetwork(chain, name="A")
+    with open(port_file, "w") as f:
+        f.write(str(net.port))
+    # Produce the first half of the chain BEFORE B dials (so B must
+    # range-sync), the rest as live gossip.
+    for _ in range(SLOTS // 2):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        net.publish_block(sb)
+    # Wait for B to connect.
+    deadline = time.time() + 30
+    while not net.node.peers and time.time() < deadline:
+        time.sleep(0.1)
+    for _ in range(SLOTS - SLOTS // 2):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        net.publish_block(sb)
+        time.sleep(0.2)
+    net.node.processor.run_until_idle()
+    time.sleep(2.0)  # let B finish importing
+    print(json.dumps({"node": "A", "head_slot": chain.head.slot,
+                      "head": chain.head.root.hex()}), flush=True)
+    return 0
+
+
+def node_b(port_file: str) -> int:
+    from lighthouse_tpu.network.transport import WireNetwork
+
+    _h, chain = _make_chain()
+    net = WireNetwork(chain, name="B")
+    deadline = time.time() + 30
+    while not os.path.exists(port_file) and time.time() < deadline:
+        time.sleep(0.1)
+    port = int(open(port_file).read())
+    peer = net.dial(port)
+    # Initial range sync to the peer's head, then follow gossip.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        target = peer.head_slot()
+        if chain.head.slot >= target >= SLOTS:
+            break
+        if target > chain.head.slot:
+            net.node._range_sync(target)
+        net.node.processor.run_until_idle()
+        time.sleep(0.2)
+    print(json.dumps({"node": "B", "head_slot": chain.head.slot,
+                      "head": chain.head.root.hex()}), flush=True)
+    return 0 if chain.head.slot >= SLOTS else 1
+
+
+def main() -> int:
+    if len(sys.argv) > 1:
+        role, port_file = sys.argv[1], sys.argv[2]
+        return node_a(port_file) if role == "a" else node_b(port_file)
+    import tempfile
+    port_file = os.path.join(tempfile.mkdtemp(), "port")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    pa = subprocess.Popen([sys.executable, __file__, "a", port_file],
+                          stdout=subprocess.PIPE, text=True, env=env)
+    pb = subprocess.Popen([sys.executable, __file__, "b", port_file],
+                          stdout=subprocess.PIPE, text=True, env=env)
+    out_a, _ = pa.communicate(timeout=180)
+    out_b, _ = pb.communicate(timeout=180)
+    print(out_a.strip())
+    print(out_b.strip())
+    a = json.loads([l for l in out_a.splitlines() if l.startswith("{")][-1])
+    b = json.loads([l for l in out_b.splitlines() if l.startswith("{")][-1])
+    ok = (a["head"] == b["head"] and a["head_slot"] == SLOTS
+          and pa.returncode == 0 and pb.returncode == 0)
+    print("TESTNET", "CONVERGED" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Pairing-kernel arithmetic on CPU — no TPU required.
+
+VERDICT r3 #7: the Pallas kernels were untested off the real chip.  True
+``interpret=True`` emulation is infeasible here (one 8-leaf Merkle chunk
+exceeds 9 minutes of interpreter time on this box), so these tests bind
+the kernel constant planes on the host and drive the EXACT in-kernel
+helper functions (`k_mont_mul`, the fq2/fq6/fq12 tower, the RCB point
+law, Frobenius, `hash64_planes`) with eager jnp arrays against the host
+oracles — the same traced code Mosaic lowers on-chip, minus the lowering.
+The on-chip lowering itself is exercised by ``bench.py`` and
+``scripts/validate_pairing_kernels.py`` on the real device.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto import fields as F
+from lighthouse_tpu.crypto import limb_field as LF
+from lighthouse_tpu.crypto import pairing_kernel as PK
+from lighthouse_tpu.crypto import curve as C
+
+random.seed(0xC0FFEE)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def bind_consts():
+    """Bind the packed constant planes exactly as the kernels do."""
+    PK._bind_consts(
+        jnp.asarray(PK.CONSTS_PLANES),
+        jnp.asarray(PK.X_BITS_FULL.reshape(-1, 1).astype(np.int32)),
+        jnp.asarray(PK.P_MINUS_2_BITS.reshape(-1, 1).astype(np.int32)))
+    yield
+
+
+def _to_plane(vals) -> jnp.ndarray:
+    """ints → (26, M) Montgomery limb plane."""
+    cols = np.stack([LF.to_mont(v) for v in vals], axis=1)
+    return jnp.asarray(cols)
+
+
+def _from_plane(plane) -> list[int]:
+    arr = np.asarray(plane)
+    return [LF.from_mont(arr[:, i]) for i in range(arr.shape[1])]
+
+
+M = 3  # lanes
+
+
+def test_k_mont_mul_matches_host():
+    a = [random.randrange(F.P) for _ in range(M)]
+    b = [random.randrange(F.P) for _ in range(M)]
+    got = _from_plane(PK.k_mont_mul(_to_plane(a), _to_plane(b)))
+    assert got == [x * y % F.P for x, y in zip(a, b)]
+
+
+def test_k_add_sub_neg_muls_match_host():
+    a = [random.randrange(F.P) for _ in range(M)]
+    b = [random.randrange(F.P) for _ in range(M)]
+    pa, pb = _to_plane(a), _to_plane(b)
+    assert _from_plane(PK.k_add(pa, pb)) == [(x + y) % F.P
+                                            for x, y in zip(a, b)]
+    assert _from_plane(PK.k_sub(pa, pb)) == [(x - y) % F.P
+                                            for x, y in zip(a, b)]
+    assert _from_plane(PK.k_neg(pa)) == [(-x) % F.P for x in a]
+    assert _from_plane(PK.k_muls(pa, 12)) == [x * 12 % F.P for x in a]
+
+
+def test_k_fq_inv_matches_host():
+    a = [random.randrange(1, F.P) for _ in range(M)]
+    got = _from_plane(PK.k_fq_inv(_to_plane(a)))
+    assert got == [pow(x, -1, F.P) for x in a]
+
+
+def _fq2_plane(vals):
+    return (_to_plane([v[0] for v in vals]), _to_plane([v[1] for v in vals]))
+
+
+def _fq2_from_plane(pl):
+    c0 = _from_plane(pl[0])
+    c1 = _from_plane(pl[1])
+    return list(zip(c0, c1))
+
+
+def _rand_fq2():
+    return (random.randrange(F.P), random.randrange(F.P))
+
+
+def test_kernel_fq2_mul_matches_host():
+    a = [_rand_fq2() for _ in range(M)]
+    b = [_rand_fq2() for _ in range(M)]
+    got = _fq2_from_plane(PK.fq2_mul(_fq2_plane(a), _fq2_plane(b)))
+    assert got == [F.fq2_mul(x, y) for x, y in zip(a, b)]
+
+
+def _rand_fq12():
+    return tuple(tuple(_rand_fq2() for _ in range(3)) for _ in range(2))
+
+
+def _fq12_plane(vals):
+    return tuple(
+        tuple(_fq2_plane([v[i][j] for v in vals]) for j in range(3))
+        for i in range(2))
+
+
+def _fq12_from_plane(p):
+    out = [[[None] * 3 for _ in range(2)] for _ in range(M)]
+    for i in range(2):
+        for j in range(3):
+            for m, c in enumerate(_fq2_from_plane(p[i][j])):
+                out[m][i][j] = c
+    return [tuple(tuple(row) for row in v) for v in out]
+
+
+def test_kernel_fq12_mul_and_frobenius_match_host():
+    a = [_rand_fq12() for _ in range(M)]
+    b = [_rand_fq12() for _ in range(M)]
+    got = _fq12_from_plane(PK.fq12_mul(_fq12_plane(a), _fq12_plane(b)))
+    assert got == [F.fq12_mul(x, y) for x, y in zip(a, b)]
+    for n in (1, 2, 3):
+        gotf = _fq12_from_plane(PK.fq12_frobenius(_fq12_plane(a), n))
+        assert gotf == [F.fq12_frobenius(x, n) for x in a]
+
+
+def test_kernel_fq12_inv_matches_host():
+    a = [_rand_fq12() for _ in range(M)]
+    got = _fq12_from_plane(PK.fq12_inv(_fq12_plane(a)))
+    for g, x in zip(got, a):
+        assert F.fq12_mul(g, x) == F.FQ12_ONE
+
+
+def test_kernel_g1_point_add_matches_host():
+    ps = [C.g1_mul(C.G1_GEN, 3 + i) for i in range(M)]
+    qs = [C.g1_mul(C.G1_GEN, 1009 + i) for i in range(M)]
+
+    def proj(points):
+        xs = _to_plane([p[0] for p in points])
+        ys = _to_plane([p[1] for p in points])
+        zs = _to_plane([1] * len(points))
+        return (xs, ys, zs)
+
+    X, Y, Z = PK.point_add(PK._G1ops, proj(ps), proj(qs))
+    xi = _from_plane(X)
+    yi = _from_plane(Y)
+    zi = _from_plane(Z)
+    for i in range(M):
+        z_inv = pow(zi[i], -1, F.P)
+        got = (xi[i] * z_inv % F.P, yi[i] * z_inv % F.P)
+        assert got == C.g1_add(ps[i], qs[i])
+
+
+def test_kernel_hash64_planes_matches_hashlib():
+    rng = np.random.default_rng(5)
+    left = rng.integers(0, 2**32, (4, 8), dtype=np.uint32)
+    right = rng.integers(0, 2**32, (4, 8), dtype=np.uint32)
+    from lighthouse_tpu.ops.merkle_kernel import hash64_planes
+    lp = [jnp.asarray(left.T[w:w + 1]) for w in range(8)]
+    rp = [jnp.asarray(right.T[w:w + 1]) for w in range(8)]
+    out = np.concatenate([np.asarray(p) for p in hash64_planes(lp, rp)],
+                         axis=0).T  # (4, 8)
+    for i in range(4):
+        msg = left[i].astype(">u4").tobytes() + right[i].astype(">u4").tobytes()
+        want = hashlib.sha256(msg).digest()
+        got = out[i].astype(">u4").tobytes()
+        assert got == want

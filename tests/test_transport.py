@@ -1,0 +1,90 @@
+"""Wire transport: framed TCP gossip + Req/Resp between two nodes.
+
+VERDICT r3 item 10 — real sockets behind the GossipBus/ReqResp seams (the
+in-process architecture unchanged); the 2-process version of this test is
+``scripts/two_node_testnet.py``.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.network.transport import WireNetwork
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+def _node(h):
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=_genesis_root(h),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    return WireNetwork(chain, name=f"n{id(chain) % 97}")
+
+
+def _genesis_root(h):
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    return hdr.tree_hash_root()
+
+
+def _wait(cond, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_gossip_block_crosses_sockets():
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    a = _node(h)
+    b = _node(h)
+    try:
+        b.dial(a.port)
+        assert _wait(lambda: a.node.peers)  # accept side registered
+        sb = h.build_block()
+        h.apply_block(sb)
+        a.node.chain.per_slot_task(int(sb.message.slot))
+        b.node.chain.per_slot_task(int(sb.message.slot))
+        a.publish_block(sb)
+        assert _wait(lambda: (a.node.processor.run_until_idle() or True)
+                     and a.node.chain.head.slot == int(sb.message.slot))
+        assert _wait(lambda: (b.node.processor.run_until_idle() or True)
+                     and b.node.chain.head.slot == int(sb.message.slot))
+        assert a.node.chain.head.root == b.node.chain.head.root
+    finally:
+        a.close()
+        b.close()
+
+
+def test_late_joiner_range_syncs_over_wire():
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    a = _node(h)
+    b = _node(h)  # same genesis snapshot, empty store — a late joiner
+    # A advances alone.
+    for _ in range(4):
+        sb = h.build_block()
+        h.apply_block(sb)
+        a.node.chain.per_slot_task(int(sb.message.slot))
+        a.node.chain.process_block(sb)
+    try:
+        peer = b.dial(a.port)
+        assert peer.head_slot() == 4
+        assert b.node._range_sync(4)
+        assert b.node.chain.head.slot == 4
+        assert b.node.chain.head.root == a.node.chain.head.root
+    finally:
+        a.close()
+        b.close()
