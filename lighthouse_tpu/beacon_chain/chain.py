@@ -49,6 +49,46 @@ class CanonicalHead:
     state: object
 
 
+class SyncMessagePool:
+    """Naive per-slot aggregation of sync-committee messages
+    (`naive_aggregation_pool.rs`, sync flavour): votes keyed by
+    (slot, beacon_block_root), bits by committee position, signatures
+    G2-aggregated on read."""
+
+    def __init__(self, preset):
+        self.preset = preset
+        # (slot, root) → {position: signature_bytes}
+        self._votes: dict = {}
+
+    def insert(self, slot: int, block_root: bytes, positions, signature:
+               bytes) -> None:
+        entry = self._votes.setdefault((slot, bytes(block_root)), {})
+        for pos in positions:
+            entry.setdefault(int(pos), bytes(signature))
+
+    def aggregate(self, slot: int, block_root: bytes, T):
+        """SyncAggregate over the collected votes (empty if none)."""
+        from ..crypto import bls
+        entry = self._votes.get((slot, bytes(block_root)), {})
+        bits = [False] * self.preset.SYNC_COMMITTEE_SIZE
+        sigs_ = []
+        for pos, sig in entry.items():
+            if pos < len(bits):
+                bits[pos] = True
+                # One signature instance PER SET BIT: a validator holding
+                # several committee positions contributes its signature
+                # once per position (spec SyncAggregate semantics).
+                sigs_.append(bls.Signature.deserialize(sig))
+        agg = (bls.aggregate_signatures(sigs_).serialize() if sigs_
+               else b"\xc0" + b"\x00" * 95)
+        return T.SyncAggregate(sync_committee_bits=bits,
+                               sync_committee_signature=agg)
+
+    def prune(self, before_slot: int) -> None:
+        self._votes = {k: v for k, v in self._votes.items()
+                       if k[0] >= before_slot}
+
+
 class BeaconChain:
     """Single-process chain runtime."""
 
@@ -65,6 +105,7 @@ class BeaconChain:
         self.observed_aggregators = ObservedAggregators()
         self.observed_block_producers = ObservedBlockProducers()
         self.payload_verifier = None  # execution-layer seam
+        self.sync_message_pool = SyncMessagePool(preset)
         self.genesis_block_root = genesis_block_root
         self.fork_choice = ForkChoice(
             preset, spec, genesis_root=genesis_block_root,
@@ -142,6 +183,7 @@ class BeaconChain:
         chain.observed_aggregators = ObservedAggregators()
         chain.observed_block_producers = ObservedBlockProducers()
         chain.payload_verifier = None
+        chain.sync_message_pool = SyncMessagePool(preset)
         chain.genesis_block_root = genesis_root
         chain.genesis_state_root = genesis_state_root
         chain.fork_choice = fc
@@ -159,6 +201,27 @@ class BeaconChain:
                                    state=head_state)
         return chain
 
+    @classmethod
+    def from_checkpoint(cls, *, store: HotColdDB, anchor_state,
+                        anchor_block, preset, spec, T, slot_clock=None):
+        """Checkpoint (weak-subjectivity) sync boot: start the chain from a
+        trusted finalized state + its block instead of genesis
+        (`client/src/builder.rs:209-391` weak_subjectivity_state).  The
+        anchor acts as the fork-choice root; historical blocks below it
+        arrive later via backfill (:mod:`..network.backfill`)."""
+        anchor_root = anchor_block.message.tree_hash_root()
+        expect = bytes(anchor_block.message.state_root)
+        got = anchor_state.tree_hash_root()
+        if got != expect:
+            raise BlockError(
+                f"anchor state root {got.hex()} does not match the anchor "
+                f"block's {expect.hex()} — refusing untrusted checkpoint")
+        chain = cls(store=store, genesis_state=anchor_state,
+                    genesis_block_root=anchor_root, preset=preset,
+                    spec=spec, T=T, slot_clock=slot_clock)
+        store.put_block(anchor_root, anchor_block)
+        return chain
+
     # -- time ----------------------------------------------------------------
 
     def current_slot(self) -> int:
@@ -171,6 +234,8 @@ class BeaconChain:
         self.fork_choice.on_tick(slot)
         self.observed_attesters.prune(slot // self.preset.SLOTS_PER_EPOCH)
         self.observed_block_producers.prune(slot)
+        # Sync votes are only read for the previous slot's aggregate.
+        self.sync_message_pool.prune(slot - 1)
 
     # -- state lookup --------------------------------------------------------
 

@@ -21,6 +21,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--preset", choices=["minimal", "mainnet"],
                    default="minimal")
     p.add_argument("--validators", type=int, default=64)
+    p.add_argument("--spec-config", default="",
+                   help="chain spec config.yaml (overrides the preset's "
+                        "built-in spec)")
+    p.add_argument("--dump-config", default="",
+                   help="write the effective chain spec YAML to PATH and "
+                        "exit (`clap_utils` --dump-config)")
+
+
+def _effective_spec(args):
+    from .types.chain_spec import ChainSpec
+
+    if getattr(args, "spec_config", ""):
+        return ChainSpec.from_yaml(open(args.spec_config).read())
+    return None  # harness default for the preset
 
 
 def _setup(args):
@@ -30,7 +44,15 @@ def _setup(args):
 
     bls.set_backend(args.backend if hasattr(args, "backend") else "fake")
     preset = MINIMAL if args.preset == "minimal" else MAINNET
-    return StateHarness(n_validators=args.validators, preset=preset)
+    spec = _effective_spec(args)
+    kwargs = {}
+    if spec is not None:
+        # The genesis state's fork follows the LOADED spec's schedule —
+        # building (say) a Capella state under a config whose forks sit at
+        # far-future would split the state shape from the transition code.
+        kwargs["fork"] = spec.fork_name_at_epoch(0)
+    return StateHarness(n_validators=args.validators, preset=preset,
+                        spec=spec, **kwargs)
 
 
 def cmd_transition_blocks(args) -> int:
@@ -253,6 +275,15 @@ def main(argv=None) -> int:
     db.set_defaults(fn=cmd_db)
 
     args = ap.parse_args(argv)
+    if getattr(args, "dump_config", ""):
+        from .types.chain_spec import ChainSpec
+        spec = _effective_spec(args) or (
+            ChainSpec.minimal() if getattr(args, "preset", "") == "minimal"
+            else ChainSpec.mainnet())
+        with open(args.dump_config, "w") as f:
+            f.write(spec.to_yaml())
+        print(f"wrote effective chain spec to {args.dump_config}")
+        return 0
     return args.fn(args)
 
 

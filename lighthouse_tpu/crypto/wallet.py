@@ -1,0 +1,88 @@
+"""EIP-2386 hierarchical-deterministic wallets — the ``eth2_wallet`` crate
+(``/root/reference/crypto/eth2_wallet/``).
+
+A wallet is an encrypted seed (the same EIP-2335 crypto module as a
+keystore) plus bookkeeping: uuid, name, type ``hierarchical deterministic``
+and a ``nextaccount`` counter; validator keystores derive from the seed at
+EIP-2334 paths ``m/12381/3600/<account>/0/0``.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid as uuid_mod
+from dataclasses import dataclass, field
+
+from .key_derivation import derive_path, validator_signing_path
+from .keystore import Keystore, KeystoreError
+from . import bls
+
+
+class WalletError(ValueError):
+    pass
+
+
+@dataclass
+class Wallet:
+    """EIP-2386 JSON wallet (type ``hierarchical deterministic``)."""
+
+    crypto: dict
+    name: str
+    uuid: str
+    nextaccount: int = 0
+    version: int = 1
+    type: str = "hierarchical deterministic"
+
+    @classmethod
+    def create(cls, name: str, password: str, seed: bytes,
+               scrypt_n: int = 16384) -> "Wallet":
+        """Encrypt ``seed`` under ``password`` (same KDF/cipher/checksum
+        module as EIP-2335 keystores, per EIP-2386 §Crypto)."""
+        if not 16 <= len(seed) <= 64:
+            raise WalletError("seed must be 16..64 bytes")
+        ks = Keystore.encrypt(seed, password, pubkey=b"", path="",
+                              kdf="scrypt", scrypt_n=scrypt_n)
+        return cls(crypto=ks.crypto, name=name,
+                   uuid=str(uuid_mod.uuid4()))
+
+    def decrypt_seed(self, password: str) -> bytes:
+        ks = Keystore(crypto=self.crypto, pubkey="", path="",
+                      uuid=self.uuid, version=4)
+        return ks.decrypt(password)
+
+    def next_validator(self, wallet_password: str,
+                       keystore_password: str,
+                       scrypt_n: int = 16384) -> Keystore:
+        """Derive the keystore for account ``nextaccount`` and advance the
+        counter (`eth2_wallet` ``next_validator``)."""
+        seed = self.decrypt_seed(wallet_password)
+        path = validator_signing_path(self.nextaccount)
+        sk_int = derive_path(seed, path)
+        sk = bls.SecretKey(sk_int)
+        ks = Keystore.encrypt(sk.serialize(), keystore_password,
+                              pubkey=sk.public_key().serialize(),
+                              path=path, scrypt_n=scrypt_n)
+        self.nextaccount += 1
+        return ks
+
+    # -- JSON ----------------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "crypto": self.crypto,
+            "name": self.name,
+            "nextaccount": self.nextaccount,
+            "type": self.type,
+            "uuid": self.uuid,
+            "version": self.version,
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "Wallet":
+        raw = json.loads(text)
+        if raw.get("type") != "hierarchical deterministic":
+            raise WalletError("unsupported wallet type")
+        if int(raw.get("version", 0)) != 1:
+            raise WalletError("unsupported wallet version")
+        return cls(crypto=raw["crypto"], name=raw["name"],
+                   uuid=raw["uuid"], nextaccount=int(raw["nextaccount"]))

@@ -38,3 +38,65 @@ def hex_bytes(data: str) -> bytes:
     if not data.startswith("0x"):
         raise ValueError("expected 0x-prefixed hex")
     return bytes.fromhex(data[2:])
+
+
+def from_json(cls: type, obj: Any) -> Any:
+    """Spec-JSON structure → SSZ value of type ``cls`` — the decode half of
+    ``serde_utils`` (the Beacon-API request path: publish block, pool
+    submissions).  Inverse of :func:`to_json`."""
+    from . import boolean, core
+    from ..types.validators import ValidatorRegistry
+
+    name = cls.__name__
+    if issubclass(cls, Container):
+        kwargs = {}
+        for fname, ftype in cls.FIELDS.items():
+            if fname not in obj:
+                raise core.SszError(f"{name}: missing field {fname}")
+            kwargs[fname] = from_json(ftype, obj[fname])
+        return cls(**kwargs)
+    if cls is boolean:
+        if not isinstance(obj, (bool, np.bool_)):
+            raise core.SszError(f"{name}: expected a bool")
+        return bool(obj)
+    if issubclass(cls, core._Uint):
+        return int(obj)
+    elem = getattr(cls, "ELEM", None)
+    if elem is not None:
+        if elem.__name__ == "Validator" and hasattr(cls, "LIMIT"):
+            vals = [from_json(elem, v) for v in obj]
+            return ValidatorRegistry.from_validators(vals)
+        if isinstance(obj, str):
+            raise core.SszError(f"{name}: expected an array")
+        out = [from_json(elem, v) for v in obj]
+        if issubclass(elem, core._Uint):
+            import numpy as _np
+            dtype = {8: _np.uint8, 16: _np.uint16, 32: _np.uint32,
+                     64: _np.uint64}.get(elem.BITS)
+            if dtype is not None:
+                return _np.asarray(out, dtype=dtype)
+        return out
+    if name.startswith(("Bitvector", "Bitlist")):
+        if isinstance(obj, str):  # spec wire form: 0x-hex bitfield
+            return cls.deserialize(hex_bytes(obj))
+        return [bool(b) for b in obj]
+    if isinstance(obj, str):  # ByteVector / ByteList / raw bytes fields
+        return hex_bytes(obj)
+    if isinstance(obj, list):
+        if obj and isinstance(obj[0], str):
+            if obj[0].startswith("0x"):
+                # Columnar byte-row vectors (roots vectors etc.): rows of
+                # equal-width 0x-hex → (n, w) u8 array.
+                rows = [hex_bytes(r) for r in obj]
+                return np.frombuffer(b"".join(rows), np.uint8).reshape(
+                    len(rows), -1).copy()
+            # Columnar uint lists (balances, inactivity scores): decimal
+            # strings → u64 array.
+            return np.asarray([int(v) for v in obj], dtype=np.uint64)
+        if obj and isinstance(obj[0], (bool, np.bool_)):
+            return np.asarray(obj, dtype=bool)
+        if obj and isinstance(obj[0], (int, np.integer)):
+            return np.asarray(obj, dtype=np.uint64)
+        if not obj:
+            return []
+    raise core.SszError(f"cannot decode JSON into {name}")

@@ -174,6 +174,51 @@ class ChainSpec:
 
     # -- constructors --------------------------------------------------------
 
+    # -- YAML config (`config.yaml`, `chain_spec.rs` from_config) ------------
+
+    def to_yaml(self) -> str:
+        """Spec ``config.yaml`` conventions: UPPER_SNAKE keys, fork
+        versions as 0x-hex, epochs as ints (None → far-future)."""
+        import dataclasses
+        import yaml
+        out = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            key = f.name.upper()
+            if isinstance(v, bytes):
+                v = "0x" + v.hex()
+            elif v is None:
+                v = FAR_FUTURE_EPOCH
+            out[key] = v
+        return yaml.safe_dump(out, sort_keys=False)
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "ChainSpec":
+        """Load a spec `config.yaml`; unknown keys are ignored (forward
+        compatibility, like the reference's serde defaults)."""
+        import dataclasses
+        import yaml
+        raw = yaml.safe_load(text) or {}
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for key, v in raw.items():
+            name = key.lower()
+            f = fields.get(name)
+            if f is None:
+                continue
+            if isinstance(f.default, bytes):
+                # Bytes fields (fork versions): published configs write
+                # them as UNQUOTED 0x-hex, which PyYAML resolves to int —
+                # convert either form to the field's byte width.
+                if isinstance(v, str):
+                    v = bytes.fromhex(v.removeprefix("0x"))
+                elif isinstance(v, int):
+                    v = v.to_bytes(len(f.default), "big")
+            elif isinstance(v, str) and v.startswith("0x"):
+                v = bytes.fromhex(v[2:])
+            kwargs[name] = v
+        return cls(**kwargs)
+
     @classmethod
     def mainnet(cls) -> "ChainSpec":
         return cls()

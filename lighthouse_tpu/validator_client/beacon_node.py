@@ -155,9 +155,11 @@ class InProcessBeaconNode:
             voluntary_exits=parts["voluntary_exits"],
         )
         if fork >= ForkName.ALTAIR:
-            body_kw["sync_aggregate"] = T.SyncAggregate(
-                sync_committee_bits=[False] * preset.SYNC_COMMITTEE_SIZE,
-                sync_committee_signature=b"\xc0" + b"\x00" * 95)
+            # Real aggregate from the naive sync-message pool: the block at
+            # slot N carries votes for the parent root signed at slot N-1
+            # (`process_sync_aggregate` previous-slot semantics).
+            body_kw["sync_aggregate"] = self.chain.sync_message_pool.aggregate(
+                slot - 1, chain.head.root, T)
         if fork >= ForkName.BELLATRIX:
             body_kw["execution_payload"] = self._payload(state, fork)
         if fork >= ForkName.CAPELLA:
@@ -209,6 +211,59 @@ class InProcessBeaconNode:
 
     def submit_attestations(self, atts: List) -> None:
         self.chain.process_attestation_batch(atts)
+
+    # -- sync committee ------------------------------------------------------
+
+    def _pk_to_index(self, reg) -> dict:
+        """pubkey → validator index, maintained incrementally (the
+        registry only grows and pubkeys are immutable once set — the
+        `ValidatorPubkeyCache` role; rebuilding per slot would walk the
+        whole 1M-entry registry inside the slot budget)."""
+        cache = getattr(self, "_pk_index_cache", None)
+        if cache is None:
+            cache = self._pk_index_cache = [0, {}]
+        n, table = cache
+        if n < len(reg):
+            pk = reg.pubkey
+            for i in range(n, len(reg)):
+                table[pk[i].tobytes()] = i
+            cache[0] = len(reg)
+        return table
+
+    def sync_committee_positions(self, indices: Sequence[int]
+                                 ) -> dict[int, list[int]]:
+        """validator index → committee positions in the CURRENT sync
+        committee (`/eth2/v1/validator/duties/sync` role)."""
+        state = self.chain.head.state
+        if not hasattr(state, "current_sync_committee"):
+            return {}
+        pk_to_index = self._pk_to_index(state.validators)
+        out: dict[int, list[int]] = {}
+        wanted = set(int(i) for i in indices)
+        for pos, pk in enumerate(state.current_sync_committee.pubkeys):
+            vi = pk_to_index.get(bytes(pk))
+            if vi is not None and vi in wanted:
+                out.setdefault(vi, []).append(pos)
+        return out
+
+    def submit_sync_messages(self, slot: int, block_root: bytes,
+                             items: List) -> None:
+        """items: (positions, signature) per validator — naive-aggregated
+        for the next block's SyncAggregate."""
+        for positions, sig in items:
+            self.chain.sync_message_pool.insert(slot, block_root,
+                                                positions, sig)
+
+    # -- preparation ---------------------------------------------------------
+
+    def prepare_proposers(self, preparations: List) -> None:
+        """(validator_index, fee_recipient) registrations
+        (`preparation_service.rs` → `prepare_beacon_proposer`)."""
+        store = getattr(self.chain, "proposer_preparations", None)
+        if store is None:
+            store = self.chain.proposer_preparations = {}
+        for idx, fee_recipient in preparations:
+            store[int(idx)] = bytes(fee_recipient)
 
 
 class BeaconNodeFallback:

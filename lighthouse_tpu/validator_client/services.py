@@ -128,6 +128,70 @@ class AttestationService:
         return n
 
 
+class SyncCommitteeService:
+    """Per-slot sync-committee signing (`sync_committee_service.rs`): each
+    member signs the current head root; the BN naive-aggregates into the
+    next block's SyncAggregate."""
+
+    def __init__(self, store: ValidatorStore, fallback: BeaconNodeFallback,
+                 preset, log: Logger):
+        self.store = store
+        self.fallback = fallback
+        self.preset = preset
+        self.log = log.child("sync_committee_service")
+
+    def on_slot(self, slot: int) -> int:
+        def run(bn):
+            duties = bn.sync_committee_positions(self.store.indices())
+            if not duties:
+                return 0
+            head_root = bn.chain.head.root
+            state = bn.chain.head.state
+            items = []
+            for vi, positions in duties.items():
+                pk = next((p for p, i in self.store.index_by_pubkey.items()
+                           if i == vi), None)
+                if pk is None or pk in self.store.doppelganger_blocked:
+                    continue
+                sig = self.store.sign_sync_committee_message(
+                    pk, slot, head_root, state, self.preset)
+                items.append((positions, sig))
+            bn.submit_sync_messages(slot, head_root, items)
+            return len(items)
+
+        n = self.fallback.first_success(run)
+        if n:
+            self.log.debug("sync committee signed", slot=slot, count=n)
+        return n
+
+
+class PreparationService:
+    """Fee-recipient registration (`preparation_service.rs`): tell the BN
+    which execution address each managed proposer wants, once per epoch."""
+
+    def __init__(self, store: ValidatorStore, fallback: BeaconNodeFallback,
+                 preset, log: Logger,
+                 fee_recipient: bytes = b"\x00" * 20):
+        self.store = store
+        self.fallback = fallback
+        self.preset = preset
+        self.fee_recipient = fee_recipient
+        self.log = log.child("preparation_service")
+        self._last_epoch = -1
+
+    def on_slot(self, slot: int) -> None:
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        if epoch == self._last_epoch:
+            return
+        self._last_epoch = epoch
+        preparations = [(i, self.fee_recipient)
+                        for i in self.store.indices()]
+        self.fallback.first_success(
+            lambda bn: bn.prepare_proposers(preparations))
+        self.log.debug("proposers prepared", epoch=epoch,
+                       count=len(preparations))
+
+
 class DoppelgangerService:
     """Two-epoch liveness watch before any signing
     (`doppelganger_service.rs:253,421`)."""
@@ -197,6 +261,10 @@ class ValidatorClient:
         self.attestations = AttestationService(store, self.duties,
                                                self.fallback, preset,
                                                self.log)
+        self.sync_committee = SyncCommitteeService(store, self.fallback,
+                                                   preset, self.log)
+        self.preparation = PreparationService(store, self.fallback, preset,
+                                              self.log)
         self.doppelganger: Optional[DoppelgangerService] = (
             DoppelgangerService(store, self.fallback, 0, self.log)
             if doppelganger else None)
@@ -207,5 +275,7 @@ class ValidatorClient:
             self.duties.poll(epoch)
         if self.doppelganger is not None:
             self.doppelganger.check_epoch(epoch)
+        self.preparation.on_slot(slot)
         self.blocks.on_slot(slot)
         self.attestations.on_slot(slot)
+        self.sync_committee.on_slot(slot)

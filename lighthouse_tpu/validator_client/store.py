@@ -74,3 +74,14 @@ class ValidatorStore:
         domain = get_domain(state, Domain.RANDAO, epoch, preset)
         root = compute_signing_root(uint64.hash_tree_root(epoch), domain)
         return self.keys[pubkey].sign(root).serialize()
+
+    def sign_sync_committee_message(self, pubkey: bytes, slot: int,
+                                    block_root: bytes, state,
+                                    preset) -> bytes:
+        """Sync-committee vote over a beacon block root (`sync_committee
+        _service.rs` signing; not slashable — no DB entry)."""
+        self._check_doppelganger(pubkey)
+        domain = get_domain(state, Domain.SYNC_COMMITTEE,
+                            slot // preset.SLOTS_PER_EPOCH, preset)
+        root = compute_signing_root(bytes(block_root), domain)
+        return self.keys[pubkey].sign(root).serialize()

@@ -1,0 +1,114 @@
+"""Checkpoint (weak-subjectivity) sync boot + reverse backfill.
+
+VERDICT r3 missing #5 — boot from a trusted state + block instead of
+genesis (`client/src/builder.rs:209-391`), then download history BACKWARD
+with hash-chain + batched-signature validation
+(`network/src/sync/backfill_sync/`, `historical_blocks.rs`).
+"""
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain, BlockError
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.network.backfill import BackfillError, BackfillSync
+from lighthouse_tpu.network.service import GossipBus, NetworkNode
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+def _source_node(n_slots=10):
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=hdr.tree_hash_root(),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    last = None
+    for _ in range(n_slots):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb)
+        last = sb
+    return h, chain, last
+
+
+def test_checkpoint_boot_and_backfill():
+    h, source, anchor_block = _source_node(10)
+    anchor_state = source.state_at_block_root(source.head.root)
+    # Checkpoint boot: only the anchor, nothing older.
+    target = BeaconChain.from_checkpoint(
+        store=HotColdDB.memory(h.preset, h.spec, h.T),
+        anchor_state=anchor_state, anchor_block=anchor_block,
+        preset=h.preset, spec=h.spec, T=h.T)
+    assert target.head.slot == 10
+    assert target.head.root == source.head.root
+    # The checkpoint node keeps following the chain forward.
+    sb = h.build_block()
+    h.apply_block(sb)
+    target.per_slot_task(int(sb.message.slot))
+    target.process_block(sb)
+    assert target.head.slot == 11
+
+    # Backfill history over the peer protocol.
+    src_node = NetworkNode(source, GossipBus(), name="src")
+    bf = BackfillSync(target, batch_size=4)
+    assert not bf.progress.complete
+    while not bf.progress.complete:
+        if not bf.fill_from(src_node):
+            break
+    assert bf.progress.complete
+    # Every historical block is now present and linked.
+    root = anchor_block.message.tree_hash_root()
+    seen = 0
+    while True:
+        blk = target.store.get_block(root)
+        if blk is None:
+            break
+        seen += 1
+        root = bytes(blk.message.parent_root)
+    assert seen == 10  # anchor + 9 ancestors
+
+
+def test_checkpoint_rejects_mismatched_state():
+    h, source, anchor_block = _source_node(3)
+    wrong_state = source.head.state.copy()
+    wrong_state.slot = 999  # no longer matches the anchor block's root
+    with pytest.raises(BlockError):
+        BeaconChain.from_checkpoint(
+            store=HotColdDB.memory(h.preset, h.spec, h.T),
+            anchor_state=wrong_state, anchor_block=anchor_block,
+            preset=h.preset, spec=h.spec, T=h.T)
+
+
+def test_backfill_rejects_broken_chain():
+    h, source, anchor_block = _source_node(6)
+    anchor_state = source.state_at_block_root(source.head.root)
+    target = BeaconChain.from_checkpoint(
+        store=HotColdDB.memory(h.preset, h.spec, h.T),
+        anchor_state=anchor_state, anchor_block=anchor_block,
+        preset=h.preset, spec=h.spec, T=h.T)
+
+    class EvilPeer:
+        def blocks_by_range(self, req):
+            src_node = NetworkNode(source, GossipBus(), name="src")
+            blocks = src_node.blocks_by_range(req)
+            # Corrupt a block body: the hash chain must break.
+            bad = type(blocks[-1]).deserialize(
+                type(blocks[-1]).serialize(blocks[-1]))
+            bad.message.state_root = b"\x66" * 32
+            blocks[-1] = bad
+            return blocks
+
+    bf = BackfillSync(target, batch_size=4)
+    with pytest.raises(BackfillError):
+        bf.fill_from(EvilPeer())

@@ -41,3 +41,19 @@ def test_bn_runs_briefly_and_db_inspect(tmp_path, capsys):
     assert main(["db", os.path.join(datadir, "beacon.sqlite")]) == 0
     cols = json.loads(capsys.readouterr().out)
     assert cols.get("BeaconMeta", 0) >= 1
+
+
+def test_dump_and_load_spec_config(tmp_path, capsys):
+    from lighthouse_tpu.cli import main
+    from lighthouse_tpu.types.chain_spec import ChainSpec
+
+    path = str(tmp_path / "config.yaml")
+    assert main(["bn", "--dump-config", path]) == 0
+    spec = ChainSpec.from_yaml(open(path).read())
+    assert spec == ChainSpec.minimal()
+    # Custom config feeds the node: tweak a value and run one tick.
+    spec2 = ChainSpec.from_yaml(open(path).read())
+    spec2.shard_committee_period = 7
+    open(path, "w").write(spec2.to_yaml())
+    assert main(["bn", "--spec-config", path, "--validators", "8",
+                 "--http-port", "0", "--run-for", "0.5"]) == 0

@@ -349,3 +349,28 @@ def test_pep563_string_annotations_resolve():
     assert list(Cp.FIELDS) == ["epoch", "root"]
     c = Cp(epoch=9)
     assert Cp.deserialize(c.encode()) == c
+
+
+def test_spec_json_roundtrip_signed_block_and_state():
+    """serde_utils decode half (`from_json`): to_json → from_json must
+    reproduce the identical SSZ encoding (spec-JSON wire convention)."""
+    from lighthouse_tpu.crypto import bls as B
+    from lighthouse_tpu.ssz.json import from_json, to_json
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        h.extend_chain(3)
+        sb = h.build_block()
+        cls = type(sb)
+        j = to_json(sb)
+        back = from_json(cls, j)
+        assert cls.serialize(back) == cls.serialize(sb)
+        scls = type(h.state)
+        js = to_json(h.state)
+        back_state = from_json(scls, js)
+        assert scls.serialize(back_state) == scls.serialize(h.state)
+    finally:
+        B.set_backend("python")
