@@ -1,0 +1,86 @@
+"""External block-builder client — the builder-API side of
+``/root/reference/beacon_node/execution_layer/src/lib.rs`` (the
+``BuilderBid`` flow) and Lighthouse's ``eth2::BuilderHttpClient``.
+
+Flow (builder-specs): the VC registers validators with the builder;
+at proposal time the BN asks ``GET /eth/v1/builder/header/{slot}/
+{parent_hash}/{pubkey}`` for a ``SignedBuilderBid`` carrying an
+ExecutionPayloadHeader + value; the proposer signs a BLINDED block over
+that header; ``POST /eth/v1/builder/blinded_blocks`` reveals the full
+payload.  Falling back to the local engine when the builder misbehaves is
+the caller's job (`execution_layer/src/lib.rs` get_payload local/builder
+race) — here we implement the transport + bid verification.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import Optional
+from urllib.parse import urlparse
+
+from . import EngineError
+from .engine_api import json_to_payload_fields, payload_to_json
+
+
+class BuilderError(EngineError):
+    pass
+
+
+class BuilderHttpClient:
+    def __init__(self, url: str, timeout: float = 3.0):
+        self.url = url.rstrip("/")
+        self._parsed = urlparse(self.url)
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> tuple[int, bytes]:
+        conn = http.client.HTTPConnection(
+            self._parsed.hostname or "127.0.0.1",
+            self._parsed.port or 18550, timeout=self.timeout)
+        try:
+            conn.request(method, path,
+                         None if body is None else json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise BuilderError(f"builder transport failure: {e}")
+        finally:
+            conn.close()
+
+    # -- builder-specs routes ------------------------------------------------
+
+    def register_validators(self, registrations: list[dict]) -> None:
+        """`POST /eth/v1/builder/validators` — signed validator
+        registrations (fee recipient + gas limit per key)."""
+        status, _ = self._request(
+            "POST", "/eth/v1/builder/validators", registrations)
+        if status != 200:
+            raise BuilderError(f"register_validators: HTTP {status}")
+
+    def get_header(self, slot: int, parent_hash: bytes,
+                   pubkey: bytes) -> Optional[dict]:
+        """`GET /eth/v1/builder/header/...` → bid dict with
+        ``header`` (payload-header JSON), ``value`` (wei int), ``pubkey``.
+        None when the builder has no bid (204)."""
+        status, data = self._request(
+            "GET", f"/eth/v1/builder/header/{slot}/0x{parent_hash.hex()}"
+                   f"/0x{pubkey.hex()}")
+        if status == 204:
+            return None
+        if status != 200:
+            raise BuilderError(f"get_header: HTTP {status}")
+        msg = json.loads(data)["data"]["message"]
+        return {"header": msg["header"],
+                "value": int(msg["value"]),
+                "pubkey": msg["pubkey"]}
+
+    def submit_blinded_block(self, signed_blinded_json: dict) -> dict:
+        """`POST /eth/v1/builder/blinded_blocks` → the unblinded
+        ExecutionPayload field dict."""
+        status, data = self._request(
+            "POST", "/eth/v1/builder/blinded_blocks", signed_blinded_json)
+        if status != 200:
+            raise BuilderError(f"submit_blinded_block: HTTP {status}")
+        return json_to_payload_fields(json.loads(data)["data"])

@@ -1,0 +1,289 @@
+"""Engine-API JSON-RPC transport with JWT auth.
+
+Counterpart of ``/root/reference/beacon_node/execution_layer/src/engine_api/
+http.rs`` (method names, per-method timeouts, capability exchange) and
+``engine_api/auth.rs`` (HS256 JWT with an ``iat`` claim per the
+execution-apis authentication spec).  The transport is stdlib
+``http.client`` — one persistent connection per engine, re-opened on
+failure — so the beacon node can drive a real execution client (geth,
+nethermind, ...) with no third-party dependencies.
+
+Serialization follows the execution-apis JSON conventions: camelCase
+field names, ``0x``-prefixed hex for both QUANTITY (minimal-length) and
+DATA (fixed-length) values.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.client
+import json
+import time
+from typing import Any, List, Optional
+from urllib.parse import urlparse
+
+from . import Engine, EngineError, PayloadStatus
+
+# Method names + timeouts (`engine_api/http.rs:30-50`).
+ETH_SYNCING = "eth_syncing"
+ENGINE_NEW_PAYLOAD_V1 = "engine_newPayloadV1"
+ENGINE_NEW_PAYLOAD_V2 = "engine_newPayloadV2"
+ENGINE_GET_PAYLOAD_V1 = "engine_getPayloadV1"
+ENGINE_GET_PAYLOAD_V2 = "engine_getPayloadV2"
+ENGINE_FORKCHOICE_UPDATED_V1 = "engine_forkchoiceUpdatedV1"
+ENGINE_FORKCHOICE_UPDATED_V2 = "engine_forkchoiceUpdatedV2"
+ENGINE_EXCHANGE_CAPABILITIES = "engine_exchangeCapabilities"
+
+TIMEOUTS = {
+    ETH_SYNCING: 1.0,
+    ENGINE_NEW_PAYLOAD_V1: 8.0,
+    ENGINE_NEW_PAYLOAD_V2: 8.0,
+    ENGINE_GET_PAYLOAD_V1: 2.0,
+    ENGINE_GET_PAYLOAD_V2: 2.0,
+    ENGINE_FORKCHOICE_UPDATED_V1: 8.0,
+    ENGINE_FORKCHOICE_UPDATED_V2: 8.0,
+    ENGINE_EXCHANGE_CAPABILITIES: 1.0,
+}
+
+LIGHTHOUSE_CAPABILITIES = [
+    ENGINE_NEW_PAYLOAD_V1, ENGINE_NEW_PAYLOAD_V2,
+    ENGINE_GET_PAYLOAD_V1, ENGINE_GET_PAYLOAD_V2,
+    ENGINE_FORKCHOICE_UPDATED_V1, ENGINE_FORKCHOICE_UPDATED_V2,
+]
+
+
+# ---------------------------------------------------------------------------
+# JWT (auth.rs; execution-apis authentication.md)
+# ---------------------------------------------------------------------------
+
+
+def _b64url(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+class JwtAuth:
+    """HS256 token minting over a 32-byte shared secret (`auth.rs:100-126`).
+
+    A fresh token is minted per request with ``iat`` = now — engines
+    reject tokens older than 60 s, so caching would only save a μs HMAC.
+    """
+
+    def __init__(self, secret: bytes):
+        if len(secret) != 32:
+            raise EngineError(f"jwt secret must be 32 bytes, got {len(secret)}")
+        self.secret = secret
+
+    @classmethod
+    def from_hex_file(cls, path: str) -> "JwtAuth":
+        with open(path) as f:
+            text = f.read().strip()
+        return cls(bytes.fromhex(text[2:] if text.startswith("0x") else text))
+
+    def token(self, now: Optional[int] = None) -> str:
+        header = _b64url(json.dumps(
+            {"typ": "JWT", "alg": "HS256"}, separators=(",", ":")).encode())
+        claims = _b64url(json.dumps(
+            {"iat": int(now if now is not None else time.time())},
+            separators=(",", ":")).encode())
+        signing_input = header + b"." + claims
+        sig = _b64url(hmac.new(self.secret, signing_input,
+                               hashlib.sha256).digest())
+        return (signing_input + b"." + sig).decode()
+
+
+# ---------------------------------------------------------------------------
+# JSON <-> payload types (json_structures.rs)
+# ---------------------------------------------------------------------------
+
+
+def _q(v: int) -> str:
+    """QUANTITY: minimal big-endian hex."""
+    return hex(int(v))
+
+
+def _d(v) -> str:
+    """DATA: fixed-length hex."""
+    return "0x" + bytes(v).hex()
+
+
+def payload_to_json(payload) -> dict:
+    """ExecutionPayload container → engine-API JSON (ExecutionPayloadV1/V2)."""
+    out = {
+        "parentHash": _d(payload.parent_hash),
+        "feeRecipient": _d(payload.fee_recipient),
+        "stateRoot": _d(payload.state_root),
+        "receiptsRoot": _d(payload.receipts_root),
+        "logsBloom": _d(payload.logs_bloom),
+        "prevRandao": _d(payload.prev_randao),
+        "blockNumber": _q(payload.block_number),
+        "gasLimit": _q(payload.gas_limit),
+        "gasUsed": _q(payload.gas_used),
+        "timestamp": _q(payload.timestamp),
+        "extraData": _d(payload.extra_data),
+        "baseFeePerGas": _q(payload.base_fee_per_gas),
+        "blockHash": _d(payload.block_hash),
+        "transactions": [_d(tx) for tx in payload.transactions],
+    }
+    if hasattr(payload, "withdrawals"):
+        out["withdrawals"] = [{
+            "index": _q(w.index),
+            "validatorIndex": _q(w.validator_index),
+            "address": _d(w.address),
+            "amount": _q(w.amount),
+        } for w in payload.withdrawals]
+    return out
+
+
+def json_to_payload_fields(obj: dict) -> dict:
+    """Engine-API JSON → kwargs for the ExecutionPayload container."""
+    fields = {
+        "parent_hash": bytes.fromhex(obj["parentHash"][2:]),
+        "fee_recipient": bytes.fromhex(obj["feeRecipient"][2:]),
+        "state_root": bytes.fromhex(obj["stateRoot"][2:]),
+        "receipts_root": bytes.fromhex(obj["receiptsRoot"][2:]),
+        "logs_bloom": bytes.fromhex(obj["logsBloom"][2:]),
+        "prev_randao": bytes.fromhex(obj["prevRandao"][2:]),
+        "block_number": int(obj["blockNumber"], 16),
+        "gas_limit": int(obj["gasLimit"], 16),
+        "gas_used": int(obj["gasUsed"], 16),
+        "timestamp": int(obj["timestamp"], 16),
+        "extra_data": bytes.fromhex(obj["extraData"][2:]),
+        "base_fee_per_gas": int(obj["baseFeePerGas"], 16),
+        "block_hash": bytes.fromhex(obj["blockHash"][2:]),
+        "transactions": [bytes.fromhex(tx[2:])
+                         for tx in obj["transactions"]],
+    }
+    if "withdrawals" in obj:
+        fields["withdrawals"] = [{
+            "index": int(w["index"], 16),
+            "validator_index": int(w["validatorIndex"], 16),
+            "address": bytes.fromhex(w["address"][2:]),
+            "amount": int(w["amount"], 16),
+        } for w in obj["withdrawals"]]
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# The transport
+# ---------------------------------------------------------------------------
+
+
+class HttpJsonRpcEngine(Engine):
+    """One execution engine over authenticated JSON-RPC (`http.rs`
+    HttpJsonRpc + `engines.rs` Engine).  Thread-compatible: callers
+    serialize through the ExecutionLayer's first-up routing."""
+
+    def __init__(self, url: str, jwt: JwtAuth):
+        self.url = url
+        self.jwt = jwt
+        self._parsed = urlparse(url)
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._id = 0
+        self.capabilities: Optional[List[str]] = None
+
+    # -- wire ---------------------------------------------------------------
+
+    def _connect(self, timeout: float) -> http.client.HTTPConnection:
+        host = self._parsed.hostname or "127.0.0.1"
+        port = self._parsed.port or 8551
+        return http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def rpc(self, method: str, params: list) -> Any:
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method, "params": params})
+        headers = {
+            "Content-Type": "application/json",
+            "Authorization": "Bearer " + self.jwt.token(),
+        }
+        timeout = TIMEOUTS.get(method, 8.0)
+        for attempt in (0, 1):  # one silent reconnect on a dead keep-alive
+            conn = self._conn
+            if conn is None:
+                conn = self._connect(timeout)
+            try:
+                conn.request("POST", self._parsed.path or "/", body, headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                self._conn = conn
+                break
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                self._conn = None
+                if attempt:
+                    raise EngineError(f"{method}: transport failure: {e}")
+        if resp.status != 200:
+            raise EngineError(f"{method}: HTTP {resp.status}")
+        try:
+            obj = json.loads(data)
+        except ValueError as e:
+            raise EngineError(f"{method}: bad JSON from engine: {e}")
+        if obj.get("error") is not None:
+            err = obj["error"]
+            raise EngineError(
+                f"{method}: engine error {err.get('code')}: "
+                f"{err.get('message')}")
+        return obj.get("result")
+
+    # -- Engine interface ---------------------------------------------------
+
+    def exchange_capabilities(self) -> List[str]:
+        caps = self.rpc(ENGINE_EXCHANGE_CAPABILITIES,
+                        [LIGHTHOUSE_CAPABILITIES])
+        self.capabilities = list(caps or [])
+        return self.capabilities
+
+    def new_payload(self, payload) -> PayloadStatus:
+        method = (ENGINE_NEW_PAYLOAD_V2 if hasattr(payload, "withdrawals")
+                  else ENGINE_NEW_PAYLOAD_V1)
+        result = self.rpc(method, [payload_to_json(payload)])
+        try:
+            return PayloadStatus(result["status"])
+        except (TypeError, KeyError, ValueError):
+            raise EngineError(f"{method}: malformed status: {result!r}")
+
+    def forkchoice_updated(self, head_hash: bytes, safe_hash: bytes,
+                           finalized_hash: bytes,
+                           payload_attributes=None) -> Optional[bytes]:
+        fc_state = {"headBlockHash": _d(head_hash),
+                    "safeBlockHash": _d(safe_hash),
+                    "finalizedBlockHash": _d(finalized_hash)}
+        attrs = None
+        if payload_attributes is not None:
+            attrs = {
+                "timestamp": _q(payload_attributes["timestamp"]),
+                "prevRandao": _d(payload_attributes["prev_randao"]),
+                "suggestedFeeRecipient": _d(
+                    payload_attributes["suggested_fee_recipient"]),
+            }
+            if "withdrawals" in payload_attributes:  # capella: V2 attrs
+                attrs["withdrawals"] = [{
+                    "index": _q(w["index"]),
+                    "validatorIndex": _q(w["validator_index"]),
+                    "address": _d(w["address"]),
+                    "amount": _q(w["amount"]),
+                } for w in payload_attributes["withdrawals"]]
+        method = (ENGINE_FORKCHOICE_UPDATED_V2
+                  if attrs is not None and "withdrawals" in attrs
+                  else ENGINE_FORKCHOICE_UPDATED_V1)
+        result = self.rpc(method, [fc_state, attrs])
+        status = (result or {}).get("payloadStatus", {}).get("status")
+        if status == PayloadStatus.INVALID.value:
+            raise EngineError(f"{method}: INVALID forkchoice state")
+        pid = (result or {}).get("payloadId")
+        return bytes.fromhex(pid[2:]) if pid else None
+
+    def get_payload(self, payload_id: bytes):
+        # V2 responses wrap the payload with a block value; V1 is bare.
+        try:
+            result = self.rpc(ENGINE_GET_PAYLOAD_V2, [_d(payload_id)])
+            if result and "executionPayload" in result:
+                return json_to_payload_fields(result["executionPayload"])
+        except EngineError:
+            result = self.rpc(ENGINE_GET_PAYLOAD_V1, [_d(payload_id)])
+        return json_to_payload_fields(result)
+
+    def is_syncing(self) -> bool:
+        return bool(self.rpc(ETH_SYNCING, []))

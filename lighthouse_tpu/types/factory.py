@@ -293,6 +293,28 @@ class SpecTypes:
         BeaconBlockBellatrix, SignedBeaconBlockBellatrix = _make_block(BeaconBlockBodyBellatrix)
         BeaconBlockCapella, SignedBeaconBlockCapella = _make_block(BeaconBlockBodyCapella)
 
+        # -- blinded blocks (builder flow) ------------------------------------
+        # The payload is replaced by its header; because the header's
+        # tree-hash equals the full payload's, a blinded block and its
+        # unblinded twin share one root — the `AbstractExecPayload`
+        # machinery of `consensus/types/src/payload.rs` collapses to two
+        # parallel container families here.
+
+        class BlindedBeaconBlockBodyBellatrix(_BodyCommon):
+            sync_aggregate: SyncAggregate
+            execution_payload_header: ExecutionPayloadHeaderBellatrix
+
+        class BlindedBeaconBlockBodyCapella(_BodyCommon):
+            sync_aggregate: SyncAggregate
+            execution_payload_header: ExecutionPayloadHeaderCapella
+            bls_to_execution_changes: List(
+                SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)
+
+        BlindedBeaconBlockBellatrix, SignedBlindedBeaconBlockBellatrix = \
+            _make_block(BlindedBeaconBlockBodyBellatrix)
+        BlindedBeaconBlockCapella, SignedBlindedBeaconBlockCapella = \
+            _make_block(BlindedBeaconBlockBodyCapella)
+
         # -- states per fork -------------------------------------------------
 
         JustificationBits = Bitvector(4)
@@ -405,6 +427,14 @@ class SpecTypes:
             ForkName.CAPELLA: (ExecutionPayloadCapella,
                                ExecutionPayloadHeaderCapella),
         }
+        self._blinded_by_fork = {
+            ForkName.BELLATRIX: (BlindedBeaconBlockBellatrix,
+                                 SignedBlindedBeaconBlockBellatrix,
+                                 BlindedBeaconBlockBodyBellatrix),
+            ForkName.CAPELLA: (BlindedBeaconBlockCapella,
+                               SignedBlindedBeaconBlockCapella,
+                               BlindedBeaconBlockBodyCapella),
+        }
 
     # -- fork-indexed access (superstruct's common accessors) ---------------
 
@@ -426,6 +456,15 @@ class SpecTypes:
     def payload_header_cls(self, fork: ForkName) -> type:
         return self._payload_by_fork[fork][1]
 
+    def blinded_block_cls(self, fork: ForkName) -> type:
+        return self._blinded_by_fork[fork][0]
+
+    def signed_blinded_block_cls(self, fork: ForkName) -> type:
+        return self._blinded_by_fork[fork][1]
+
+    def blinded_body_cls(self, fork: ForkName) -> type:
+        return self._blinded_by_fork[fork][2]
+
     def fork_of_state(self, state) -> ForkName:
         for fork, (scls, *_rest) in self._by_fork.items():
             if type(state) is scls:
@@ -434,6 +473,9 @@ class SpecTypes:
 
     def fork_of_block(self, block) -> ForkName:
         for fork, (_s, bcls, sbcls, _body) in self._by_fork.items():
+            if type(block) is bcls or type(block) is sbcls:
+                return fork
+        for fork, (bcls, sbcls, _body) in self._blinded_by_fork.items():
             if type(block) is bcls or type(block) is sbcls:
                 return fork
         raise TypeError(f"not a BeaconBlock: {type(block).__name__}")

@@ -1,0 +1,123 @@
+"""Blinded-payload abstraction + builder client tests
+(`consensus/types/src/payload.rs` root-equality invariant and the
+builder-API flow of `execution_layer/src/lib.rs`)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lighthouse_tpu.execution_layer.builder import (
+    BuilderError,
+    BuilderHttpClient,
+)
+from lighthouse_tpu.execution_layer.engine_api import payload_to_json
+from lighthouse_tpu.types.factory import spec_types
+from lighthouse_tpu.types.payload import (
+    blind_block,
+    payload_to_header,
+    unblind_block,
+)
+from lighthouse_tpu.types.presets import MINIMAL
+
+T = spec_types(MINIMAL)
+
+
+def _full_block(fork="capella"):
+    block = T.block_cls(fork).default()
+    block.slot = 9
+    block.proposer_index = 3
+    block.parent_root = b"\x77" * 32
+    p = block.body.execution_payload
+    p.block_hash = b"\x11" * 32
+    p.block_number = 42
+    p.transactions = [b"\x02tx1", b"\x02tx2"]
+    if fork == "capella":
+        w = T.Withdrawal.default()
+        w.index, w.validator_index, w.amount = 1, 2, 10**9
+        p.withdrawals = [w]
+    return block
+
+
+@pytest.mark.parametrize("fork", ["bellatrix", "capella"])
+def test_blinded_root_equals_full_root(fork):
+    block = _full_block(fork)
+    blinded = blind_block(block, T)
+    # THE invariant: builder and proposer commit to one root.
+    assert blinded.tree_hash_root() == block.tree_hash_root()
+
+
+def test_unblind_roundtrip_and_substitution_rejection():
+    block = _full_block()
+    blinded = blind_block(block, T)
+    payload = block.body.execution_payload
+    back = unblind_block(blinded, payload, T)
+    assert back.tree_hash_root() == block.tree_hash_root()
+    # A builder revealing a DIFFERENT payload than the committed header
+    # must be refused.
+    tampered = block.copy().body.execution_payload
+    tampered.transactions = [b"\x02evil"]
+    with pytest.raises(ValueError):
+        unblind_block(blinded, tampered, T)
+
+
+class _MockBuilder(BaseHTTPRequestHandler):
+    payload_json: dict = {}
+    registrations: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/eth/v1/builder/header/"):
+            self._json({"data": {"message": {
+                "header": {"blockHash": "0x" + "11" * 32},
+                "value": "1000000000",
+                "pubkey": "0x" + "aa" * 48}}})
+        else:
+            self._json({}, 404)
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        if self.path == "/eth/v1/builder/validators":
+            type(self).registrations.append(body)
+            self._json({})
+        elif self.path == "/eth/v1/builder/blinded_blocks":
+            self._json({"data": type(self).payload_json})
+        else:
+            self._json({}, 404)
+
+
+@pytest.fixture()
+def builder():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _MockBuilder)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield BuilderHttpClient(
+        f"http://127.0.0.1:{srv.server_address[1]}")
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_builder_flow(builder):
+    builder.register_validators(
+        [{"message": {"fee_recipient": "0x" + "00" * 20}}])
+    bid = builder.get_header(9, b"\x77" * 32, b"\xaa" * 48)
+    assert bid["value"] == 10**9
+    assert bid["header"]["blockHash"] == "0x" + "11" * 32
+    # reveal: builder returns the full payload for the signed blinded block
+    block = _full_block()
+    _MockBuilder.payload_json = payload_to_json(
+        block.body.execution_payload)
+    fields = builder.submit_blinded_block({"message": "..."})
+    assert fields["block_number"] == 42
+    assert fields["transactions"] == [b"\x02tx1", b"\x02tx2"]

@@ -1,0 +1,159 @@
+"""Engine-API JSON-RPC transport tests — a real HTTP server speaking the
+engine protocol, validating the JWT on every request (the role of the
+reference's `engine_api/http.rs` tests with their mocked EL server)."""
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lighthouse_tpu.execution_layer import EngineError, PayloadStatus
+from lighthouse_tpu.execution_layer.engine_api import (
+    ENGINE_EXCHANGE_CAPABILITIES,
+    ENGINE_FORKCHOICE_UPDATED_V2,
+    ENGINE_NEW_PAYLOAD_V2,
+    HttpJsonRpcEngine,
+    JwtAuth,
+    json_to_payload_fields,
+    payload_to_json,
+)
+from lighthouse_tpu.types.factory import spec_types
+from lighthouse_tpu.types.presets import MINIMAL
+
+SECRET = bytes(range(32))
+T = spec_types(MINIMAL)
+
+
+def _check_jwt(token: str) -> bool:
+    try:
+        h, c, sig = token.split(".")
+        signing = (h + "." + c).encode()
+        want = base64.urlsafe_b64encode(
+            hmac.new(SECRET, signing, hashlib.sha256).digest()).rstrip(b"=")
+        if want.decode() != sig:
+            return False
+        pad = "=" * (-len(c) % 4)
+        claims = json.loads(base64.urlsafe_b64decode(c + pad))
+        return abs(time.time() - claims["iat"]) < 60
+    except Exception:
+        return False
+
+
+class _EngineHandler(BaseHTTPRequestHandler):
+    calls: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        auth = self.headers.get("Authorization", "")
+        if not (auth.startswith("Bearer ") and _check_jwt(auth[7:])):
+            self.send_response(401)
+            self.end_headers()
+            return
+        req = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        type(self).calls.append(req)
+        method, params = req["method"], req["params"]
+        if method == ENGINE_EXCHANGE_CAPABILITIES:
+            result = params[0]  # echo: engine supports everything we do
+        elif method == ENGINE_NEW_PAYLOAD_V2:
+            result = {"status": "VALID", "latestValidHash": None,
+                      "validationError": None}
+        elif method == ENGINE_FORKCHOICE_UPDATED_V2:
+            result = {"payloadStatus": {"status": "VALID"},
+                      "payloadId": "0x" + "ab" * 8}
+        elif method == "engine_getPayloadV2":
+            result = {"executionPayload": type(self).payload_json,
+                      "blockValue": "0x0"}
+        elif method == "eth_syncing":
+            result = False
+        else:
+            self._reply(req["id"], None,
+                        {"code": -32601, "message": "unknown method"})
+            return
+        self._reply(req["id"], result, None)
+
+    def _reply(self, rid, result, error):
+        body = json.dumps({"jsonrpc": "2.0", "id": rid,
+                           "result": result, "error": error}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def engine():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _EngineHandler)
+    _EngineHandler.calls = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield HttpJsonRpcEngine(url, JwtAuth(SECRET))
+    srv.shutdown()
+    srv.server_close()
+
+
+def _capella_payload():
+    p = T.payload_cls("capella").default()
+    p.block_hash = b"\x11" * 32
+    p.parent_hash = b"\x22" * 32
+    p.block_number = 7
+    p.base_fee_per_gas = 10**9
+    p.transactions = [b"\x02abc"]
+    return p
+
+
+def test_jwt_roundtrip_and_rejection():
+    auth = JwtAuth(SECRET)
+    assert _check_jwt(auth.token())
+    assert not _check_jwt(auth.token(now=int(time.time()) - 3600))
+    tampered = auth.token()[:-2] + "xx"
+    assert not _check_jwt(tampered)
+    with pytest.raises(EngineError):
+        JwtAuth(b"short")
+
+
+def test_payload_json_roundtrip():
+    p = _capella_payload()
+    obj = payload_to_json(p)
+    assert obj["blockNumber"] == "0x7"
+    assert obj["blockHash"] == "0x" + "11" * 32
+    assert "withdrawals" in obj
+    back = json_to_payload_fields(obj)
+    assert back["block_hash"] == bytes(p.block_hash)
+    assert back["base_fee_per_gas"] == 10**9
+    assert back["transactions"] == [b"\x02abc"]
+
+
+def test_new_payload_and_forkchoice(engine):
+    assert engine.exchange_capabilities()
+    status = engine.new_payload(_capella_payload())
+    assert status == PayloadStatus.VALID
+    pid = engine.forkchoice_updated(
+        b"\x11" * 32, b"\x11" * 32, b"\x00" * 32,
+        payload_attributes={
+            "timestamp": 12, "prev_randao": b"\x00" * 32,
+            "suggested_fee_recipient": b"\x00" * 20, "withdrawals": []})
+    assert pid == b"\xab" * 8
+    _EngineHandler.payload_json = payload_to_json(_capella_payload())
+    fields = engine.get_payload(pid)
+    assert fields["block_number"] == 7
+    assert engine.is_syncing() is False
+    # the V2 newPayload carried the withdrawals list on the wire
+    np_call = [c for c in _EngineHandler.calls
+               if c["method"] == ENGINE_NEW_PAYLOAD_V2][0]
+    assert "withdrawals" in np_call["params"][0]
+
+
+def test_unauthenticated_request_fails(engine):
+    engine.jwt = JwtAuth(b"\x99" * 32)  # wrong secret
+    with pytest.raises(EngineError):
+        engine.new_payload(_capella_payload())
