@@ -1,0 +1,204 @@
+"""Keymanager HTTP API — the VC's standard key-management surface
+(``validator_client/src/http_api``: ``keystores.rs`` / ``remotekeys.rs``,
+implementing the Ethereum keymanager-API spec).
+
+Routes (all require ``Authorization: Bearer <api-token>``; the reference
+mints the token into ``api-token.txt`` at startup — ``api_secret.rs``):
+
+- ``GET    /eth/v1/keystores``    — list local keys
+- ``POST   /eth/v1/keystores``    — import EIP-2335 keystores (+ optional
+  EIP-3076 slashing-protection interchange)
+- ``DELETE /eth/v1/keystores``    — remove keys, export their
+  slashing-protection history (the spec requires history to travel with
+  the key so it can never attest unprotected elsewhere)
+- ``GET/POST/DELETE /eth/v1/remotekeys`` — web3signer-backed keys
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+from ..common.logging import Logger, test_logger
+from ..crypto.keystore import Keystore, KeystoreError
+from .signing import Web3SignerMethod
+from .store import ValidatorStore
+
+
+def mint_api_token() -> str:
+    """`api_secret.rs` — a bearer token the operator reads from disk."""
+    return "api-token-0x" + secrets.token_hex(32)
+
+
+class KeymanagerServer:
+    def __init__(self, store: ValidatorStore, *,
+                 genesis_validators_root: bytes = b"\x00" * 32,
+                 token: Optional[str] = None, host: str = "127.0.0.1",
+                 port: int = 0, log: Optional[Logger] = None):
+        self.store = store
+        self.gvr = genesis_validators_root
+        self.token = token or mint_api_token()
+        self.log = (log or test_logger()).child("keymanager")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authed(self) -> bool:
+                import hmac as _hmac
+                auth = self.headers.get("Authorization", "")
+                if _hmac.compare_digest(auth, "Bearer " + outer.token):
+                    return True
+                self._json({"code": 401, "message": "invalid token"}, 401)
+                return False
+
+            def do_GET(self):
+                if not self._authed():
+                    return
+                outer._route(self, "GET", b"")
+
+            def do_POST(self):
+                if not self._authed():
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                outer._route(self, "POST", self.rfile.read(n))
+
+            def do_DELETE(self):
+                if not self._authed():
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                outer._route(self, "DELETE", self.rfile.read(n))
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        self.log.info("keymanager API listening", port=self.port)
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, h, method: str, body: bytes) -> None:
+        path = urlparse(h.path).path.rstrip("/")
+        try:
+            if path == "/eth/v1/keystores":
+                h._json(getattr(self, f"_keystores_{method.lower()}")(body))
+            elif path == "/eth/v1/remotekeys":
+                h._json(getattr(self, f"_remotekeys_{method.lower()}")(body))
+            else:
+                h._json({"code": 404, "message": "unknown route"}, 404)
+        except (ValueError, KeyError) as e:
+            h._json({"code": 400, "message": str(e)}, 400)
+
+    # -- /eth/v1/keystores ---------------------------------------------------
+
+    def _local_pubkeys(self):
+        return [pk for pk, m in self.store.keys.items()
+                if not isinstance(m, Web3SignerMethod)]
+
+    def _keystores_get(self, body: bytes) -> dict:
+        return {"data": [{
+            "validating_pubkey": "0x" + pk.hex(),
+            "derivation_path": "",
+            "readonly": False,
+        } for pk in self._local_pubkeys()]}
+
+    def _keystores_post(self, body: bytes) -> dict:
+        req = json.loads(body)
+        keystores = req["keystores"]
+        passwords = req["passwords"]
+        if len(keystores) != len(passwords):
+            raise ValueError("keystores/passwords length mismatch")
+        if req.get("slashing_protection"):
+            self.store.slashing_db.import_interchange(
+                req["slashing_protection"], self.gvr)
+        statuses = []
+        for ks_json, password in zip(keystores, passwords):
+            try:
+                ks = Keystore.from_json(
+                    ks_json if isinstance(ks_json, str)
+                    else json.dumps(ks_json))
+                pk = self.store.import_keystore(ks, password)
+                statuses.append({"status": "imported",
+                                 "message": "0x" + pk.hex()})
+            except (KeystoreError, ValueError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return {"data": statuses}
+
+    def _keystores_delete(self, body: bytes) -> dict:
+        req = json.loads(body)
+        statuses = []
+        for pk_hex in req["pubkeys"]:
+            pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x")
+                               else pk_hex)
+            if self.store.remove_validator(pk):
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        # History for deleted keys travels with them (keymanager spec).
+        interchange = self.store.slashing_db.export_interchange(self.gvr)
+        return {"data": statuses, "slashing_protection": interchange}
+
+    # -- /eth/v1/remotekeys --------------------------------------------------
+
+    def _remote_methods(self):
+        return {pk: m for pk, m in self.store.keys.items()
+                if isinstance(m, Web3SignerMethod)}
+
+    def _remotekeys_get(self, body: bytes) -> dict:
+        return {"data": [{
+            "pubkey": "0x" + pk.hex(),
+            "url": m.url,
+            "readonly": False,
+        } for pk, m in self._remote_methods().items()]}
+
+    def _remotekeys_post(self, body: bytes) -> dict:
+        req = json.loads(body)
+        statuses = []
+        for item in req["remote_keys"]:
+            try:
+                pk_hex = item["pubkey"]
+                pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x")
+                                   else pk_hex)
+                if len(pk) != 48:
+                    raise ValueError("pubkey must be 48 bytes")
+                self.store.add_web3signer_validator(item["url"], pk)
+                statuses.append({"status": "imported"})
+            except (KeyError, ValueError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return {"data": statuses}
+
+    def _remotekeys_delete(self, body: bytes) -> dict:
+        req = json.loads(body)
+        statuses = []
+        remote = self._remote_methods()
+        for pk_hex in req["pubkeys"]:
+            pk = bytes.fromhex(pk_hex[2:] if pk_hex.startswith("0x")
+                               else pk_hex)
+            if pk in remote and self.store.remove_validator(pk):
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        return {"data": statuses}

@@ -1,7 +1,8 @@
 """Validator store: initialized keys + slashing-protected signing —
-``validator_client/src/validator_store.rs`` and
-``signing_method.rs:78-89`` (local-keystore signing; a remote-signer
-method slots into the same seam)."""
+``validator_client/src/validator_store.rs`` and ``signing_method.rs``
+(each key is backed by a :class:`~.signing.LocalKeystore` or a
+:class:`~.signing.Web3SignerMethod`; the store computes the signing roots
+and enforces slashing protection identically for both)."""
 
 from __future__ import annotations
 
@@ -10,12 +11,13 @@ from typing import Dict, Iterable, List, Optional
 from ..crypto import bls
 from ..state_transition.helpers import compute_signing_root, get_domain
 from ..types.chain_spec import Domain
+from .signing import LocalKeystore, Web3SignerMethod
 from .slashing_protection import SlashingDatabase, SlashingProtectionError
 
 
 class ValidatorStore:
     def __init__(self, slashing_db: Optional[SlashingDatabase] = None):
-        self.keys: Dict[bytes, bls.SecretKey] = {}  # pubkey → sk
+        self.keys: Dict[bytes, object] = {}  # pubkey → signing method
         self.index_by_pubkey: Dict[bytes, int] = {}
         self.slashing_db = slashing_db or SlashingDatabase()
         self.doppelganger_blocked: set[bytes] = set()
@@ -24,11 +26,23 @@ class ValidatorStore:
 
     def add_validator(self, sk: bls.SecretKey,
                       index: Optional[int] = None) -> bytes:
-        pk = sk.public_key().serialize()
-        self.keys[pk] = sk
+        return self.add_signing_method(LocalKeystore(sk), index)
+
+    def add_web3signer_validator(self, url: str, pubkey: bytes,
+                                 index: Optional[int] = None) -> bytes:
+        return self.add_signing_method(Web3SignerMethod(url, pubkey), index)
+
+    def add_signing_method(self, method,
+                           index: Optional[int] = None) -> bytes:
+        pk = method.pubkey
+        self.keys[pk] = method
         if index is not None:
             self.index_by_pubkey[pk] = index
         return pk
+
+    def remove_validator(self, pubkey: bytes) -> bool:
+        self.index_by_pubkey.pop(pubkey, None)
+        return self.keys.pop(pubkey, None) is not None
 
     def import_keystore(self, keystore, password: str,
                         index: Optional[int] = None) -> bytes:
@@ -49,6 +63,16 @@ class ValidatorStore:
             raise SlashingProtectionError(
                 "doppelganger protection: signing disabled")
 
+    @staticmethod
+    def _fork_info(state) -> dict:
+        f = state.fork
+        return {"fork": {
+            "previous_version": "0x" + bytes(f.previous_version).hex(),
+            "current_version": "0x" + bytes(f.current_version).hex(),
+            "epoch": str(int(f.epoch))},
+            "genesis_validators_root":
+                "0x" + bytes(state.genesis_validators_root).hex()}
+
     def sign_block(self, pubkey: bytes, block, state, preset) -> bytes:
         self._check_doppelganger(pubkey)
         epoch = int(block.slot) // preset.SLOTS_PER_EPOCH
@@ -56,7 +80,9 @@ class ValidatorStore:
         signing_root = compute_signing_root(block, domain)
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, int(block.slot), signing_root)
-        return self.keys[pubkey].sign(signing_root).serialize()
+        return self.keys[pubkey].sign(
+            signing_root, msg_type="BLOCK_V2",
+            fork_info=self._fork_info(state))
 
     def sign_attestation(self, pubkey: bytes, data, state, preset) -> bytes:
         self._check_doppelganger(pubkey)
@@ -66,14 +92,18 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, int(data.source.epoch), int(data.target.epoch),
             signing_root)
-        return self.keys[pubkey].sign(signing_root).serialize()
+        return self.keys[pubkey].sign(
+            signing_root, msg_type="ATTESTATION",
+            fork_info=self._fork_info(state))
 
     def sign_randao(self, pubkey: bytes, epoch: int, state, preset) -> bytes:
         self._check_doppelganger(pubkey)
         from ..ssz import uint64
         domain = get_domain(state, Domain.RANDAO, epoch, preset)
         root = compute_signing_root(uint64.hash_tree_root(epoch), domain)
-        return self.keys[pubkey].sign(root).serialize()
+        return self.keys[pubkey].sign(
+            root, msg_type="RANDAO_REVEAL",
+            fork_info=self._fork_info(state))
 
     def sign_sync_committee_message(self, pubkey: bytes, slot: int,
                                     block_root: bytes, state,
@@ -84,4 +114,6 @@ class ValidatorStore:
         domain = get_domain(state, Domain.SYNC_COMMITTEE,
                             slot // preset.SLOTS_PER_EPOCH, preset)
         root = compute_signing_root(bytes(block_root), domain)
-        return self.keys[pubkey].sign(root).serialize()
+        return self.keys[pubkey].sign(
+            root, msg_type="SYNC_COMMITTEE_MESSAGE",
+            fork_info=self._fork_info(state))

@@ -1,0 +1,181 @@
+"""Keymanager API + web3signer remote signing tests
+(`validator_client/src/http_api` keystores/remotekeys tests and
+`signing_method.rs` — the remote signature must verify under the same
+pubkey and the slashing DB must gate remote signing identically)."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.crypto.keystore import Keystore
+from lighthouse_tpu.validator_client import ValidatorStore
+from lighthouse_tpu.validator_client.keymanager import KeymanagerServer
+from lighthouse_tpu.validator_client.signing import (
+    SigningError,
+    Web3SignerMethod,
+)
+from lighthouse_tpu.validator_client.slashing_protection import (
+    SlashingProtectionError,
+)
+
+
+def _req(port, method, path, token, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Authorization": "Bearer " + token,
+                 "Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def km():
+    store = ValidatorStore()
+    server = KeymanagerServer(store)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_keystore_lifecycle(km):
+    port, token = km.port, km.token
+    # auth required
+    code, _ = _req(port, "GET", "/eth/v1/keystores", "wrong-token")
+    assert code == 401
+    code, out = _req(port, "GET", "/eth/v1/keystores", token)
+    assert (code, out["data"]) == (200, [])
+    # import two keystores
+    sks = [B.SecretKey(0x7000 + i) for i in range(2)]
+    keystores = [Keystore.encrypt(
+        sk.serialize(), "pw", pubkey=sk.public_key().serialize(),
+        path="m/12381/3600/0/0/0", kdf="pbkdf2").to_json() for sk in sks]
+    code, out = _req(port, "POST", "/eth/v1/keystores", token,
+                     {"keystores": keystores, "passwords": ["pw", "pw"]})
+    assert code == 200
+    assert [s["status"] for s in out["data"]] == ["imported", "imported"]
+    code, out = _req(port, "GET", "/eth/v1/keystores", token)
+    assert len(out["data"]) == 2
+    # wrong password reports error per-key, not whole-request
+    code, out = _req(port, "POST", "/eth/v1/keystores", token,
+                     {"keystores": keystores[:1], "passwords": ["bad"]})
+    assert out["data"][0]["status"] == "error"
+    # delete exports slashing protection with the key
+    pk0 = "0x" + sks[0].public_key().serialize().hex()
+    code, out = _req(port, "DELETE", "/eth/v1/keystores", token,
+                     {"pubkeys": [pk0, "0x" + "ee" * 48]})
+    assert [s["status"] for s in out["data"]] == ["deleted", "not_found"]
+    interchange = json.loads(out["slashing_protection"])
+    assert interchange["metadata"]["interchange_format_version"] == "5"
+
+
+class _MockWeb3Signer(BaseHTTPRequestHandler):
+    """A remote signer holding real secret keys."""
+    sks: dict = {}
+    requests: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        type(self).requests.append((self.path, body))
+        pk_hex = self.path.rsplit("/", 1)[-1]
+        sk = type(self).sks.get(pk_hex)
+        if sk is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        root = bytes.fromhex(body["signingRoot"][2:])
+        sig = "0x" + sk.sign(root).serialize().hex()
+        out = json.dumps({"signature": sig}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+@pytest.fixture()
+def web3signer():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _MockWeb3Signer)
+    _MockWeb3Signer.sks = {}
+    _MockWeb3Signer.requests = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_web3signer_signing_and_slashing_protection(web3signer):
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    url = f"http://127.0.0.1:{web3signer.server_address[1]}"
+    sk = B.SecretKey(0xABCD)
+    pk = sk.public_key().serialize()
+    _MockWeb3Signer.sks["0x" + pk.hex()] = sk
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    store = ValidatorStore()
+    store.add_web3signer_validator(url, pk)
+
+    block = h.T.block_cls("capella").default()
+    block.slot = 5
+    sig = store.sign_block(pk, block, h.state, h.preset)
+    # The remote signature must verify under the local pubkey over the
+    # SAME signing root a local keystore would compute.
+    from lighthouse_tpu.state_transition.helpers import (
+        compute_signing_root, get_domain)
+    from lighthouse_tpu.types.chain_spec import Domain
+    domain = get_domain(h.state, Domain.BEACON_PROPOSER,
+                        5 // h.preset.SLOTS_PER_EPOCH, h.preset)
+    root = compute_signing_root(block, domain)
+    assert B.Signature.deserialize(sig).verify(
+        B.PublicKey.deserialize(pk), root)
+    # fork info travelled on the wire (web3signer needs it for BLOCK_V2)
+    path, body = _MockWeb3Signer.requests[-1]
+    assert body["type"] == "BLOCK_V2"
+    assert "fork" in body["fork_info"]
+    # Slashing protection gates the remote path identically: a conflicting
+    # proposal at the same slot must be refused BEFORE reaching the signer.
+    n_before = len(_MockWeb3Signer.requests)
+    block2 = h.T.block_cls("capella").default()
+    block2.slot = 5
+    block2.proposer_index = 3  # different root, same slot
+    with pytest.raises(SlashingProtectionError):
+        store.sign_block(pk, block2, h.state, h.preset)
+    assert len(_MockWeb3Signer.requests) == n_before
+    # Unknown key → 404 → SigningError
+    other = B.SecretKey(0x1111).public_key().serialize()
+    method = Web3SignerMethod(url, other)
+    with pytest.raises(SigningError):
+        method.sign(b"\x00" * 32, msg_type="ATTESTATION")
+
+
+def test_remotekeys_routes(km, web3signer):
+    url = f"http://127.0.0.1:{web3signer.server_address[1]}"
+    port, token = km.port, km.token
+    pk = B.SecretKey(0x5555).public_key().serialize()
+    code, out = _req(port, "POST", "/eth/v1/remotekeys", token,
+                     {"remote_keys": [{"pubkey": "0x" + pk.hex(),
+                                       "url": url},
+                                      {"pubkey": "0xdead", "url": url}]})
+    assert [s["status"] for s in out["data"]] == ["imported", "error"]
+    code, out = _req(port, "GET", "/eth/v1/remotekeys", token)
+    assert out["data"][0]["url"] == url
+    # remote keys are not listed as local keystores
+    code, ks = _req(port, "GET", "/eth/v1/keystores", token)
+    assert ks["data"] == []
+    code, out = _req(port, "DELETE", "/eth/v1/remotekeys", token,
+                     {"pubkeys": ["0x" + pk.hex()]})
+    assert out["data"][0]["status"] == "deleted"
