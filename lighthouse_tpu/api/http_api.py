@@ -173,12 +173,106 @@ class HttpApiServer:
                     atts.append(to_json(
                         chain.op_pool._to_attestation(stored, chain.T)))
             h._json({"data": atts})
+        elif path.startswith("/eth/v1/validator/duties/proposer/"):
+            try:
+                duties = self._proposer_duties(int(path.split("/")[-1]))
+            except ValueError as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+            else:
+                h._json({"data": duties})
+        elif path == "/eth/v1/events":
+            self._serve_events(h)
         elif path == "/metrics":
             h._text(REGISTRY.encode())
+        elif path == "/lighthouse/validator_monitor":
+            mon = chain.validator_monitor
+            h._json({"data": [] if mon is None else mon.summaries()})
         elif path.startswith("/lighthouse/health"):
             h._json({"data": {"observed_attesters": "ok"}})
         else:
             h._json({"code": 404, "message": "unknown route"}, 404)
+
+    def _proposer_duties(self, epoch: int) -> list:
+        """`/eth/v1/validator/duties/proposer/{epoch}` (`validator/mod.rs`).
+
+        Restricted to the current/next WALL-CLOCK epoch like the reference:
+        past epochs computed from the head state would name wrong
+        proposers, and a far-future epoch would be an unauthenticated way
+        to make the handler advance billions of slots.  Gating on the head
+        epoch instead would deadlock a quiet chain — a VC asking for the
+        current epoch would get 400, never learn it proposes, and the head
+        would never advance.
+        """
+        from ..state_transition.committees import get_beacon_proposer_index
+        from ..state_transition.per_slot import process_slots
+        chain = self.chain
+        spe = chain.preset.SLOTS_PER_EPOCH
+        now_epoch = max(chain.current_slot(), chain.head.slot) // spe
+        if not now_epoch <= epoch <= now_epoch + 1:
+            raise ValueError(
+                f"proposer duties only for epochs {now_epoch}.."
+                f"{now_epoch + 1}")
+        state = chain.head.state
+        first = epoch * spe
+        if int(state.slot) < first:
+            # Memoise through the chain's advanced-state cache — a VC
+            # polling next-epoch duties every slot would otherwise pay a
+            # full epoch advance (~100 MB state copy + epoch processing at
+            # registry scale) per request on the API thread.
+            key = (chain.head.root, first)
+            advanced = chain._advanced_states.get(key)
+            if advanced is None:
+                advanced = process_slots(state.copy(), first, chain.preset,
+                                         chain.spec, chain.T)
+                chain._bound_advanced_states()
+                chain._advanced_states[key] = advanced
+            state = advanced
+        reg = state.validators
+        out = []
+        for slot in range(first, first + spe):
+            idx = get_beacon_proposer_index(state, chain.preset, slot=slot)
+            out.append({
+                "pubkey": "0x" + reg.pubkey[idx].tobytes().hex(),
+                "validator_index": str(idx),
+                "slot": str(slot)})
+        return out
+
+    def _serve_events(self, h) -> None:
+        """`/eth/v1/events?topics=head,block,...` — SSE stream
+        (`http_api` `events.rs`).  Holds the connection; one thread per
+        subscriber (ThreadingHTTPServer)."""
+        import queue as _queue
+        from urllib.parse import parse_qs
+        from ..beacon_chain.events import TOPICS
+        qs = parse_qs(urlparse(h.path).query)
+        # Accept both ?topics=head,block and the query-array form
+        # ?topics=head&topics=block (the beacon-API spec serialization).
+        topics = [t
+                  for part in qs.get("topics", [",".join(TOPICS)])
+                  for t in part.split(",") if t in TOPICS]
+        if not topics:
+            h._json({"code": 400, "message": "no valid topics"}, 400)
+            return
+        sub = self.chain.event_bus.subscribe(topics)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "text/event-stream")
+            h.send_header("Cache-Control", "no-cache")
+            h.end_headers()
+            while True:
+                try:
+                    topic, data = sub.get(timeout=1.0)
+                except _queue.Empty:
+                    h.wfile.write(b":keepalive\n\n")
+                    h.wfile.flush()
+                    continue
+                h.wfile.write(
+                    f"event: {topic}\ndata: {json.dumps(data)}\n\n".encode())
+                h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.chain.event_bus.unsubscribe(sub)
 
     def _route_post(self, h, body: bytes) -> None:
         path = urlparse(h.path).path.rstrip("/")

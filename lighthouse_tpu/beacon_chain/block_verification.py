@@ -64,11 +64,17 @@ class GossipVerifiedBlock:
             raise RepeatProposal(f"proposer {proposer} already proposed at "
                                  f"slot {slot}")
         # Advance the parent state to the block slot for committee checks
-        # (`cheap_state_advance_to_obtain_committees`).
-        state = chain.state_at_block_root(parent_root)
-        if int(state.slot) < slot:
-            state = process_slots(state, slot, chain.preset, chain.spec,
-                                  chain.T)
+        # (`cheap_state_advance_to_obtain_committees`) — preferring the
+        # state the per-slot timer pre-advanced (`state_advance_timer.rs`)
+        # so the gossip path skips the epoch transition.
+        adv = chain._advanced_states.get((parent_root, slot))
+        if adv is not None:
+            state = adv.copy()
+        else:
+            state = chain.state_at_block_root(parent_root)
+            if int(state.slot) < slot:
+                state = process_slots(state, slot, chain.preset, chain.spec,
+                                      chain.T)
         expected = get_beacon_proposer_index(state, chain.preset, slot=slot)
         if proposer != expected:
             raise IncorrectProposer(f"got {proposer}, expected {expected}")

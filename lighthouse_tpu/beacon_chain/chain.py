@@ -33,6 +33,7 @@ from .block_verification import (
     SignatureVerifiedBlock,
 )
 from .errors import BlockError
+from .events import EventBus
 from .observed import (
     ObservedAggregators,
     ObservedAttesters,
@@ -106,6 +107,8 @@ class BeaconChain:
         self.observed_block_producers = ObservedBlockProducers()
         self.payload_verifier = None  # execution-layer seam
         self.sync_message_pool = SyncMessagePool(preset)
+        self.event_bus = EventBus()
+        self.validator_monitor = None  # opt-in: set a ValidatorMonitor
         self.genesis_block_root = genesis_block_root
         self.fork_choice = ForkChoice(
             preset, spec, genesis_root=genesis_block_root,
@@ -184,6 +187,8 @@ class BeaconChain:
         chain.observed_block_producers = ObservedBlockProducers()
         chain.payload_verifier = None
         chain.sync_message_pool = SyncMessagePool(preset)
+        chain.event_bus = EventBus()
+        chain.validator_monitor = None
         chain.genesis_block_root = genesis_root
         chain.genesis_state_root = genesis_state_root
         chain.fork_choice = fc
@@ -236,14 +241,37 @@ class BeaconChain:
         self.observed_block_producers.prune(slot)
         # Sync votes are only read for the previous slot's aggregate.
         self.sync_message_pool.prune(slot - 1)
+        # State-advance timer (`state_advance_timer.rs`): pre-advance the
+        # head state to the new slot so the first block/attestation of the
+        # slot finds its committees without paying the epoch transition on
+        # the hot path.  Epoch boundaries are exactly where the advance is
+        # expensive AND where the shuffling changes, so warming it here
+        # moves that cost off the gossip deadline.
+        key = (self.head.root, slot)
+        if slot > self.head.slot and key not in self._advanced_states:
+            try:
+                advanced = process_slots(self.head.state.copy(), slot,
+                                         self.preset, self.spec, self.T)
+            except Exception:
+                return  # advance failure must never kill the timer tick
+            self._bound_advanced_states()
+            self._advanced_states[key] = advanced
 
     # -- state lookup --------------------------------------------------------
+
+    # Reference DEFAULT_SNAPSHOT_CACHE_SIZE (`snapshot_cache.rs`) — at
+    # registry scale each post-state is ~100 MB of columns, so the cache
+    # must be bounded; everything else reloads/replays from the store.
+    SNAPSHOT_CACHE_SIZE = 4
 
     def state_at_block_root(self, block_root: bytes):
         """Post-state of an imported block (snapshot cache role,
         `snapshot_cache.rs`), falling back to the store."""
         state = self._states_by_block.get(block_root)
         if state is not None:
+            # LRU touch: re-insert at the end so hot fork tips survive.
+            self._states_by_block.pop(block_root)
+            self._states_by_block[block_root] = state
             return state.copy()
         block = self.store.get_block(block_root)
         if block is None:
@@ -286,11 +314,13 @@ class BeaconChain:
             cached = (src if int(src.slot) >= slot
                       else process_slots(src.copy(), slot, self.preset,
                                          self.spec, self.T))
-            while len(self._advanced_states) >= 4:
-                self._advanced_states.pop(
-                    next(iter(self._advanced_states)))
+            self._bound_advanced_states()
             self._advanced_states[key] = cached
         return cached
+
+    def _bound_advanced_states(self) -> None:
+        while len(self._advanced_states) >= 4:
+            self._advanced_states.pop(next(iter(self._advanced_states)))
 
     # -- block import pipeline ----------------------------------------------
 
@@ -316,14 +346,29 @@ class BeaconChain:
         # Feed block attestations to fork choice (`beacon_chain.rs:
         # apply_attestation_to_fork_choice` via import).
         from .attestation_verification import attesting_indices
+        resolved = []
         for att in ex.signed_block.message.body.attestations:
             try:
                 idx, _committee = attesting_indices(state, att, self.preset)
+                resolved.append((int(att.data.slot), idx.tolist()))
                 self.fork_choice.on_attestation(_Indexed(
                     att.data, idx.tolist()), is_from_block=True)
             except Exception:
                 pass  # block attestations are best-effort for fork choice
+        if self.validator_monitor is not None:
+            self.validator_monitor.process_block(
+                ex.signed_block.message, resolved, state)
+        self.event_bus.publish("block", {
+            "slot": str(int(ex.signed_block.message.slot)),
+            "block": "0x" + block_root.hex()})
         self.recompute_head()
+        # Bound the snapshot cache (weak #10: between finalizations this
+        # otherwise held EVERY post-state — up to 2 epochs × ~100 MB at
+        # registry scale).  Evicted states remain loadable from the store.
+        survivors = list(self._states_by_block)
+        for root in survivors[:-self.SNAPSHOT_CACHE_SIZE]:
+            if root != self.head.root:
+                del self._states_by_block[root]
         # Finalization housekeeping: prune pool + migrate store.
         fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
         if fin_root != b"\x00" * 32 and self.fork_choice.contains_block(fin_root):
@@ -342,6 +387,23 @@ class BeaconChain:
             state = self.state_at_block_root(head_root)
             self.head = CanonicalHead(root=head_root,
                                       slot=int(state.slot), state=state)
+            # The post-block state's own latest_block_header.state_root is
+            # ZEROED until the next slot; the advertised root comes from
+            # the head block itself.
+            blk = self.store.get_block(head_root)
+            state_root = (bytes(blk.message.state_root) if blk is not None
+                          else self.genesis_state_root)
+            self.event_bus.publish("head", {
+                "slot": str(self.head.slot),
+                "block": "0x" + head_root.hex(),
+                "state": "0x" + state_root.hex()})
+            fin = self.fork_choice.finalized_checkpoint
+            if fin[1] != b"\x00" * 32 \
+                    and fin != getattr(self, "_last_finalized_event", None):
+                self._last_finalized_event = fin
+                self.event_bus.publish("finalized_checkpoint", {
+                    "epoch": str(fin[0]),
+                    "block": "0x" + fin[1].hex()})
         return self.head.root
 
     # -- attestations --------------------------------------------------------
@@ -361,6 +423,9 @@ class BeaconChain:
                 pass
             self.op_pool.insert_attestation(verified.attestation,
                                             verified.committee)
+            self.event_bus.publish("attestation", {
+                "slot": str(int(verified.attestation.data.slot)),
+                "index": str(int(verified.attestation.data.index))})
         return results
 
     # -- production ----------------------------------------------------------

@@ -141,16 +141,29 @@ def cmd_beacon_node(args) -> int:
         chain = BeaconChain(store=store, genesis_state=h.state.copy(),
                             genesis_block_root=hdr.tree_hash_root(),
                             preset=h.preset, spec=h.spec, T=h.T)
+    if args.validator_monitor_auto:
+        from .beacon_chain.validator_monitor import ValidatorMonitor
+        chain.validator_monitor = ValidatorMonitor(auto_register=True)
     api = HttpApiServer(chain, port=args.http_port)
     api.start()
     print(f"beacon node up: http://127.0.0.1:{api.port} "
           f"(validators={args.validators}, preset={args.preset})")
     vc = None
+    km = None
     if args.with_validators:
         vstore = ValidatorStore()
         for i in range(args.validators):
             vstore.add_validator(interop_secret_key(i), index=i)
         vc = ValidatorClient(vstore, [InProcessBeaconNode(chain)], h.preset)
+        if args.keymanager_port is not None:
+            from .validator_client.keymanager import KeymanagerServer
+            km = KeymanagerServer(
+                vstore, port=args.keymanager_port,
+                genesis_validators_root=bytes(
+                    h.state.genesis_validators_root))
+            km.start()
+            print(f"keymanager API up: http://127.0.0.1:{km.port} "
+                  f"token={km.token}")
     # Devnet clock: start at the next slot AFTER the (possibly resumed)
     # head — restarting at slot 0 against a resumed head would have the VC
     # proposing slot-1 blocks onto a later state.
@@ -176,6 +189,8 @@ def cmd_beacon_node(args) -> int:
     finally:
         if args.datadir:
             chain.persist()  # graceful-shutdown persistence
+    if km is not None:
+        km.stop()
     api.stop()
     return 0
 
@@ -242,6 +257,12 @@ def main(argv=None) -> int:
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--seconds-per-slot", type=int, default=2)
     bn.add_argument("--with-validators", action="store_true")
+    bn.add_argument("--keymanager-port", type=int, default=None,
+                    help="serve the keymanager API (`--http` on the "
+                         "reference VC; prints the bearer token)")
+    bn.add_argument("--validator-monitor-auto", action="store_true",
+                    help="track every observed validator "
+                         "(`--validator-monitor-auto`)")
     bn.add_argument("--datadir", default="")
     bn.add_argument("--run-for", type=float, default=0,
                     help="seconds to run (0 = forever)")
