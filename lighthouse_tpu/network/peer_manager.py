@@ -1,0 +1,107 @@
+"""Peer manager with scoring — the role of
+``/root/reference/beacon_node/lighthouse_network/src/peer_manager/``
+(``score.rs`` real-score arithmetic + ban thresholds, ``peerdb``'s
+per-peer state).
+
+Scores are a decaying real number clamped to [MIN_SCORE, MAX_SCORE]; bad
+behavior (invalid blocks, Req/Resp timeouts, dead sockets) subtracts,
+useful service adds.  Below ``BAN_THRESHOLD`` a peer is banned and every
+sync/lookup path skips it; scores decay toward zero with a halflife, so a
+ban earned from transient flakiness eventually lifts (the reference's
+``score.rs:34-57`` decay model).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Iterable, List, Optional
+
+
+MAX_SCORE = 100.0
+MIN_SCORE = -100.0
+BAN_THRESHOLD = -60.0
+SCORE_HALFLIFE_S = 600.0
+
+
+class PeerAction(Enum):
+    """(`peer_manager/mod.rs` ReportSource × score deltas)."""
+    VALID_MESSAGE = 0.3       # served a good block / fresh gossip
+    SYNC_SERVED = 1.0         # completed a range/lookup request usefully
+    TIMEOUT = -5.0            # Req/Resp deadline missed
+    UNREACHABLE = -10.0       # dead socket / connect refused
+    INVALID_MESSAGE = -25.0   # sent a block that failed verification
+    FATAL = -100.0            # protocol violation — instant ban
+
+
+@dataclass
+class PeerInfo:
+    score: float = 0.0
+    last_update: float = field(default_factory=time.monotonic)
+
+    def _decay(self, now: float) -> None:
+        dt = now - self.last_update
+        if dt > 0:
+            self.score *= 0.5 ** (dt / SCORE_HALFLIFE_S)
+            self.last_update = now
+
+    def apply(self, delta: float, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._decay(now)
+        self.score = max(MIN_SCORE, min(MAX_SCORE, self.score + delta))
+
+    def current_score(self, now: Optional[float] = None) -> float:
+        self._decay(time.monotonic() if now is None else now)
+        return self.score
+
+
+class PeerManager:
+    """Keyed by the peer's stable node id when the transport learned one
+    (the wire Status handshake carries it — `peerdb` keys by libp2p
+    PeerId), falling back to handle identity for in-process peers.  A
+    banned node that reconnects gets a NEW handle but the SAME node id, so
+    the ban follows it."""
+
+    def __init__(self, log=None):
+        self._info: Dict[object, PeerInfo] = {}
+        self.log = log
+
+    @staticmethod
+    def _key(peer):
+        return getattr(peer, "peer_id", None) or id(peer)
+
+    def _entry(self, peer) -> PeerInfo:
+        key = self._key(peer)
+        info = self._info.get(key)
+        if info is None:
+            info = self._info[key] = PeerInfo()
+        return info
+
+    def report(self, peer, action: PeerAction) -> None:
+        info = self._entry(peer)
+        before_banned = info.score <= BAN_THRESHOLD
+        info.apply(action.value)
+        if self.log is not None and not before_banned \
+                and info.score <= BAN_THRESHOLD:
+            self.log.warn("peer banned", score=round(info.score, 1),
+                          action=action.name)
+
+    def score(self, peer) -> float:
+        return self._entry(peer).current_score()
+
+    def is_banned(self, peer) -> bool:
+        return self._entry(peer).current_score() <= BAN_THRESHOLD
+
+    def best_peers(self, peers: Iterable) -> List:
+        """Non-banned peers, best score first — the sync layer's peer
+        selection order (`range_sync` peer rotation)."""
+        live = [p for p in peers if not self.is_banned(p)]
+        return sorted(live, key=lambda p: -self.score(p))
+
+    def forget(self, peer) -> None:
+        """Disconnect housekeeping: drop UNKEYED (handle-identity) entries
+        so churn cannot leak; identified peers keep their score so a ban
+        survives reconnection."""
+        if getattr(peer, "peer_id", None) is None:
+            self._info.pop(id(peer), None)

@@ -67,6 +67,8 @@ class NetworkNode:
         self.log = (log or test_logger()).child(name)
         self.processor = BeaconProcessor()
         self.peers: List["NetworkNode"] = []
+        from .peer_manager import PeerManager
+        self.peer_manager = PeerManager(log=self.log)
         self._block_handler = self._on_gossip_block
         bus.subscribe(TOPIC_BLOCK, self._block_handler)
         self._att_handler = self._on_gossip_attestation
@@ -103,10 +105,11 @@ class NetworkNode:
             self.chain.process_block(signed_block, is_timely=True)
             self.log.debug("block imported", slot=slot)
         except ParentUnknown:
-            # Parent lookup (`block_lookups/`): range-sync from a peer,
+            # Parent lookup (`block_lookups/`): try a cheap single-chain
+            # BlocksByRoot walk first, fall back to range sync, then
             # retry via the reprocess queue.
-            self.log.debug("unknown parent; range syncing", slot=slot)
-            if self._range_sync(slot):
+            self.log.debug("unknown parent; looking up", slot=slot)
+            if self._parent_lookup(signed_block) or self._range_sync(slot):
                 self.processor.defer(WorkEvent(
                     WorkType.GossipBlock, signed_block,
                     self._process_block), 0.0)
@@ -136,16 +139,77 @@ class NetworkNode:
         out.reverse()
         return out
 
+    def blocks_by_root(self, roots: List[bytes]) -> List:
+        """Serve `BlocksByRoot` (`rpc` BlocksByRoot; `block_lookups/`
+        server side) from the store."""
+        out = []
+        for root in roots:
+            block = self.chain.store.get_block(bytes(root))
+            if block is not None:
+                out.append(block)
+        return out
+
     def head_slot(self) -> int:
         """Peer-handle protocol (shared with the wire transport's
         :class:`~.transport.RemotePeer`)."""
         return self.chain.head.slot
 
+    # Parent chains longer than this go to range sync instead
+    # (`block_lookups/parent_lookup.rs` PARENT_DEPTH_TOLERANCE).
+    PARENT_DEPTH_TOLERANCE = 16
+
+    def _parent_lookup(self, signed_block) -> bool:
+        """`block_lookups/parent_lookup.rs`: walk unknown parents back via
+        BlocksByRoot until hitting a known block, then import the chain
+        oldest-first.  Cheaper than range sync for short reorg gaps."""
+        from .peer_manager import PeerAction
+        for peer in self.peer_manager.best_peers(self.peers):
+            if not hasattr(peer, "blocks_by_root"):
+                continue
+            chain_segment: List = []  # per-peer: never replay another
+            want = bytes(signed_block.message.parent_root)  # peer's segment
+            while (not self.chain.fork_choice.contains_block(want)
+                   and len(chain_segment) < self.PARENT_DEPTH_TOLERANCE):
+                try:
+                    got = peer.blocks_by_root([want])
+                except Exception:
+                    self.peer_manager.report(peer, PeerAction.TIMEOUT)
+                    break
+                if not got:
+                    break
+                parent = got[0]
+                if parent.message.tree_hash_root() != want:
+                    # served a block that is not the one asked for
+                    self.peer_manager.report(
+                        peer, PeerAction.INVALID_MESSAGE)
+                    break
+                chain_segment.append(parent)
+                want = bytes(parent.message.parent_root)
+            if self.chain.fork_choice.contains_block(want) and chain_segment:
+                ok = False
+                for b in reversed(chain_segment):
+                    try:
+                        self.chain.per_slot_task(int(b.message.slot))
+                        self.chain.process_block(b)
+                        ok = True
+                    except BlockError:
+                        pass
+                if ok:
+                    self.peer_manager.report(peer, PeerAction.SYNC_SERVED)
+                    return True
+                # Root-consistent chain whose blocks all fail verification
+                # is as malicious as garbage roots — penalize (mirrors
+                # `_range_sync`).
+                self.peer_manager.report(peer, PeerAction.INVALID_MESSAGE)
+        return False
+
     def _range_sync(self, target_slot: int) -> bool:
-        """Minimal `range_sync`: pull the missing span from the first peer
-        ahead of us and import as a chain segment."""
+        """`range_sync`: pull the missing span from the best-scored peer
+        ahead of us and import as a chain segment; peers that time out or
+        serve garbage are penalized and (eventually) banned."""
+        from .peer_manager import PeerAction
         start = self.chain.head.slot + 1
-        for peer in self.peers:
+        for peer in self.peer_manager.best_peers(self.peers):
             try:
                 if peer.head_slot() < start:
                     continue
@@ -153,8 +217,9 @@ class NetworkNode:
                     start_slot=start, count=max(target_slot - start + 1, 1)))
             except Exception as e:
                 # A stalled/dead wire peer (Req/Resp timeout, reset socket)
-                # must not abort the sync loop — try the next peer
-                # (`range_sync` peer scoring/rotation role).
+                # must not abort the sync loop — penalize and try the next
+                # peer (`range_sync` peer scoring/rotation).
+                self.peer_manager.report(peer, PeerAction.TIMEOUT)
                 self.log.warn("range-sync peer failed", peer=str(peer),
                               reason=type(e).__name__)
                 continue
@@ -167,5 +232,8 @@ class NetworkNode:
                 except BlockError:
                     pass
             if ok:
+                self.peer_manager.report(peer, PeerAction.SYNC_SERVED)
                 return True
+            elif blocks:
+                self.peer_manager.report(peer, PeerAction.INVALID_MESSAGE)
         return False

@@ -49,6 +49,7 @@ KIND_RESPONSE = 2
 
 METHOD_STATUS = 0
 METHOD_BLOCKS_BY_RANGE = 1
+METHOD_BLOCKS_BY_ROOT = 2
 
 
 def _enc_block(T, signed_block) -> bytes:
@@ -60,6 +61,27 @@ def _enc_block(T, signed_block) -> bytes:
 def _dec_block(T, data: bytes):
     fork = _FORK_BY_ID[data[0]]
     return T.signed_block_cls(fork).deserialize(data[1:])
+
+
+def _enc_block_list(T, blocks: List) -> bytes:
+    out = [struct.pack("<I", len(blocks))]
+    for b in blocks:
+        enc = _enc_block(T, b)
+        out.append(struct.pack("<I", len(enc)))
+        out.append(enc)
+    return b"".join(out)
+
+
+def _dec_block_list(T, data: bytes) -> List:
+    (n,) = struct.unpack_from("<I", data, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        out.append(_dec_block(T, data[off:off + ln]))
+        off += ln
+    return out
 
 
 def _enc_atts(T, atts: List) -> bytes:
@@ -144,6 +166,7 @@ class RemotePeer:
         self._net = net
         self._conn = conn
         self.status_head_slot = 0
+        self.peer_id = None  # learned from the first Status round-trip
 
     def head_slot(self) -> int:
         # Refresh via a Status round-trip (`rpc` Status; the reference
@@ -151,6 +174,10 @@ class RemotePeer:
         try:
             resp = self._net._request(self._conn, METHOD_STATUS, b"")
             (self.status_head_slot,) = struct.unpack("<Q", resp[:8])
+            # Stable node id: peer-manager scores/bans follow it across
+            # reconnections (the libp2p-PeerId role).
+            if len(resp) >= 48:
+                self.peer_id = resp[40:48]
         except Exception:
             pass
         return self.status_head_slot
@@ -158,15 +185,13 @@ class RemotePeer:
     def blocks_by_range(self, req: BlocksByRangeRequest) -> List:
         body = struct.pack("<QQ", req.start_slot, req.count)
         resp = self._net._request(self._conn, METHOD_BLOCKS_BY_RANGE, body)
-        (n,) = struct.unpack_from("<I", resp, 0)
-        off = 4
-        out = []
-        for _ in range(n):
-            (ln,) = struct.unpack_from("<I", resp, off)
-            off += 4
-            out.append(_dec_block(self._net.T, resp[off:off + ln]))
-            off += ln
-        return out
+        return _dec_block_list(self._net.T, resp)
+
+    def blocks_by_root(self, roots: List[bytes]) -> List:
+        body = struct.pack("<I", len(roots)) + b"".join(
+            bytes(r) for r in roots)
+        resp = self._net._request(self._conn, METHOD_BLOCKS_BY_ROOT, body)
+        return _dec_block_list(self._net.T, resp)
 
 
 class WireNetwork:
@@ -180,7 +205,9 @@ class WireNetwork:
 
     def __init__(self, chain, name: str = "node", port: int = 0,
                  log=None):
+        import secrets as _secrets
         self.T = chain.T
+        self.node_id = _secrets.token_bytes(8)
         self.bus = GossipBus()
         self.node = NetworkNode(chain, self.bus, name=name, log=log)
         self._conns: List[_Conn] = []
@@ -235,8 +262,10 @@ class WireNetwork:
             if conn in self._conns:
                 self._conns.remove(conn)
             peer = self._peers.pop(conn, None)
-        if peer is not None and peer in self.node.peers:
-            self.node.peers.remove(peer)
+        if peer is not None:
+            if peer in self.node.peers:
+                self.node.peers.remove(peer)
+            self.node.peer_manager.forget(peer)
 
     # -- gossip --------------------------------------------------------------
 
@@ -300,18 +329,17 @@ class WireNetwork:
 
     def _serve(self, method: int, body: bytes) -> bytes:
         if method == METHOD_STATUS:
-            return struct.pack("<Q32s", self.node.chain.head.slot,
-                               self.node.chain.head.root)
+            return struct.pack("<Q32s8s", self.node.chain.head.slot,
+                               self.node.chain.head.root, self.node_id)
         if method == METHOD_BLOCKS_BY_RANGE:
             start, count = struct.unpack("<QQ", body)
             blocks = self.node.blocks_by_range(
                 BlocksByRangeRequest(start_slot=start, count=count))
-            out = [struct.pack("<I", len(blocks))]
-            for b in blocks:
-                enc = _enc_block(self.T, b)
-                out.append(struct.pack("<I", len(enc)))
-                out.append(enc)
-            return b"".join(out)
+            return _enc_block_list(self.T, blocks)
+        if method == METHOD_BLOCKS_BY_ROOT:
+            (n,) = struct.unpack_from("<I", body, 0)
+            roots = [body[4 + i * 32:4 + (i + 1) * 32] for i in range(n)]
+            return _enc_block_list(self.T, self.node.blocks_by_root(roots))
         raise ValueError(f"unknown method {method}")
 
     def _request(self, conn: _Conn, method: int, body: bytes,

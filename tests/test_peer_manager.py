@@ -1,0 +1,112 @@
+"""Peer scoring + block-lookups tests (`peer_manager/score.rs` semantics,
+`block_lookups/parent_lookup.rs` walk-back import)."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.network.peer_manager import (
+    BAN_THRESHOLD,
+    PeerAction,
+    PeerManager,
+    PeerInfo,
+)
+from lighthouse_tpu.network.service import GossipBus, NetworkNode
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+def test_score_decay_and_clamp():
+    info = PeerInfo()
+    now = time.monotonic()
+    info.apply(-50.0, now)
+    assert info.current_score(now) == -50.0
+    # one halflife later the penalty has halved
+    assert abs(info.current_score(now + 600.0) + 25.0) < 1e-6
+    # clamped at MIN_SCORE no matter how many reports
+    for _ in range(10):
+        info.apply(-100.0, now + 600.0)
+    assert info.current_score(now + 600.0) == -100.0
+
+
+def test_ban_threshold_and_best_peers():
+    pm = PeerManager()
+    good, flaky, bad = object(), object(), object()
+    pm.report(good, PeerAction.SYNC_SERVED)
+    pm.report(flaky, PeerAction.TIMEOUT)
+    for _ in range(3):
+        pm.report(bad, PeerAction.INVALID_MESSAGE)
+    assert pm.is_banned(bad)
+    assert not pm.is_banned(flaky)
+    assert pm.best_peers([bad, flaky, good]) == [good, flaky]
+    # FATAL is an instant ban from zero
+    insta = object()
+    pm.report(insta, PeerAction.FATAL)
+    assert pm.is_banned(insta)
+
+
+def _make_node(h, bus, name):
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    genesis_root = hdr.tree_hash_root()
+    chain = BeaconChain(
+        store=HotColdDB.memory(h.preset, h.spec, h.T),
+        genesis_state=h.state.copy(), genesis_block_root=genesis_root,
+        preset=h.preset, spec=h.spec, T=h.T)
+    return NetworkNode(chain, bus, name=name)
+
+
+def test_parent_lookup_imports_missing_chain():
+    """A node that receives a block whose parents it never saw fills the
+    gap via BlocksByRoot walk-back instead of range sync."""
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    source = _make_node(h, GossipBus(), "source")
+    target = _make_node(h, GossipBus(), "target")  # separate bus: no gossip
+    target.peers.append(source)
+
+    blocks = []
+    for _ in range(3):
+        b = h.build_block()
+        h.apply_block(b)
+        blocks.append(b)
+        source.chain.per_slot_task(int(b.message.slot))
+        source.chain.process_block(b)
+    # target sees ONLY the tip; parents must come from the lookup
+    tip = blocks[-1]
+    assert target._parent_lookup(tip)
+    # parents imported; tip itself then imports cleanly
+    target.chain.per_slot_task(int(tip.message.slot))
+    target.chain.process_block(tip)
+    assert target.chain.head.root == source.chain.head.root
+    # the serving peer earned score
+    assert target.peer_manager.score(source) > 0
+
+
+def test_banned_peer_skipped_in_sync():
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    node = _make_node(h, GossipBus(), "n")
+
+    class DeadPeer:
+        def head_slot(self):
+            raise TimeoutError("dead")
+
+        def blocks_by_range(self, req):
+            raise TimeoutError("dead")
+
+    dead = DeadPeer()
+    node.peers.append(dead)
+    for _ in range(13):  # 13 × TIMEOUT(-5) < BAN_THRESHOLD
+        node.peer_manager.report(dead, PeerAction.TIMEOUT)
+    assert node.peer_manager.is_banned(dead)
+    assert node.peer_manager.best_peers(node.peers) == []
+    assert node._range_sync(5) is False  # no usable peers, no crash

@@ -88,3 +88,32 @@ def test_late_joiner_range_syncs_over_wire():
     finally:
         a.close()
         b.close()
+
+
+def test_blocks_by_root_over_wire_and_parent_lookup():
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    a = _node(h)
+    b = _node(h)
+    blocks = []
+    for _ in range(3):
+        sb = h.build_block()
+        h.apply_block(sb)
+        blocks.append(sb)
+        a.node.chain.per_slot_task(int(sb.message.slot))
+        a.node.chain.process_block(sb)
+    try:
+        peer = b.dial(a.port)
+        # raw Req/Resp: ask for a mid-chain block by its root
+        root = blocks[1].message.tree_hash_root()
+        got = peer.blocks_by_root([root, b"\xff" * 32])
+        assert len(got) == 1
+        assert got[0].message.tree_hash_root() == root
+        # end-to-end: the tip alone triggers a parent-lookup walk-back
+        tip = blocks[-1]
+        b.node.chain.per_slot_task(int(tip.message.slot))
+        assert b.node._parent_lookup(tip)
+        b.node.chain.process_block(tip)
+        assert b.node.chain.head.root == a.node.chain.head.root
+    finally:
+        a.close()
+        b.close()
