@@ -195,6 +195,24 @@ def cmd_beacon_node(args) -> int:
     return 0
 
 
+def cmd_boot_node(args) -> int:
+    """`boot_node`: run the standalone discovery registry."""
+    from .network.discovery import BootNode
+
+    boot = BootNode(port=args.port)
+    print(f"boot node up: udp://127.0.0.1:{boot.port}")
+    try:
+        if args.run_for:
+            time.sleep(args.run_for)
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    boot.close()
+    return 0
+
+
 def cmd_account(args) -> int:
     """`account_manager`: create/import EIP-2335 keystores."""
     import getpass
@@ -294,6 +312,13 @@ def main(argv=None) -> int:
     db = sub.add_parser("db", help="database inspection")
     db.add_argument("path")
     db.set_defaults(fn=cmd_db)
+
+    bnode = sub.add_parser("boot-node",
+                           help="standalone discovery registry "
+                                "(`boot_node` subcommand / discv5 role)")
+    bnode.add_argument("--port", type=int, default=15000)
+    bnode.add_argument("--run-for", type=float, default=0)
+    bnode.set_defaults(fn=cmd_boot_node)
 
     args = ap.parse_args(argv)
     if getattr(args, "dump_config", ""):

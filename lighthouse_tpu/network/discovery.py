@@ -1,0 +1,151 @@
+"""UDP peer discovery — the discv5 role
+(``/root/reference/beacon_node/lighthouse_network/src/discovery/`` and the
+standalone ``boot_node`` subcommand, ``boot_node/src/``).
+
+Real discv5 is a Kademlia DHT over authenticated UDP; this environment's
+stand-in keeps the deployment shape (a UDP boot node that never joins the
+chain + per-node discovery services that register and query it) with an
+ENR-lite record: ``node_id (8B) | tcp_port (u16) | head_slot (u64)``.
+
+Frames (all little-endian):
+
+    0 PING  node_id(8) tcp_port(2)      → registers the sender
+    1 PONG
+    2 FIND                              → asks for known records
+    3 NODES count(u16) records(18B each: node_id, tcp_port, ipv4)
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..common.logging import Logger, test_logger
+
+MSG_PING = 0
+MSG_PONG = 1
+MSG_FIND = 2
+MSG_NODES = 3
+
+RECORD = struct.Struct("<8sH4s")  # node_id, tcp_port, ipv4
+
+
+class BootNode:
+    """Standalone registry process (`boot_node/src/server.rs` role): keeps
+    liveness-pruned records, answers FIND with everyone it knows."""
+
+    LIVENESS_S = 60.0
+
+    def __init__(self, port: int = 0, log: Optional[Logger] = None):
+        self.log = (log or test_logger()).child("boot_node")
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", port))
+        self.port = self.sock.getsockname()[1]
+        self.records: Dict[bytes, Tuple[int, bytes, float]] = {}
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while True:
+            try:
+                data, addr = self.sock.recvfrom(4096)
+            except OSError:
+                return
+            if not data:
+                continue
+            kind = data[0]
+            if kind == MSG_PING and len(data) >= 11:
+                node_id = data[1:9]
+                (tcp_port,) = struct.unpack_from("<H", data, 9)
+                ip = socket.inet_aton(addr[0])
+                fresh = node_id not in self.records
+                self.records[node_id] = (tcp_port, ip, time.monotonic())
+                if fresh:
+                    self.log.info("peer registered",
+                                  node=node_id.hex(), port=tcp_port)
+                self.sock.sendto(bytes([MSG_PONG]), addr)
+            elif kind == MSG_FIND:
+                now = time.monotonic()
+                # Prune dead records in place — each node restart mints a
+                # fresh node_id, so a long-lived boot node would otherwise
+                # accumulate a record per restart forever.
+                self.records = {
+                    nid: rec for nid, rec in self.records.items()
+                    if now - rec[2] < self.LIVENESS_S}
+                live = [(nid, p, ip) for nid, (p, ip, seen)
+                        in self.records.items()]
+                out = [bytes([MSG_NODES]), struct.pack("<H", len(live))]
+                for nid, p, ip in live:
+                    out.append(RECORD.pack(nid, p, ip))
+                self.sock.sendto(b"".join(out), addr)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class DiscoveryService:
+    """Per-node client (`discovery/mod.rs` role): registers this node's
+    wire endpoint with the boot node and dials newly discovered peers."""
+
+    def __init__(self, node_id: bytes, tcp_port: int,
+                 boot_addr: Tuple[str, int],
+                 dial: Callable[[str, int], object],
+                 interval: float = 2.0, log: Optional[Logger] = None):
+        self.node_id = node_id
+        self.tcp_port = tcp_port
+        self.boot_addr = boot_addr
+        self.dial = dial  # (host, port) → peer handle; dedup is dial's job
+        self.interval = interval
+        self.log = (log or test_logger()).child("discovery")
+        self.known: set[bytes] = {node_id}
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.settimeout(3.0)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def poll_once(self) -> List[Tuple[bytes, int, str]]:
+        """One PING + FIND round; dials fresh records. Returns them."""
+        self.sock.sendto(
+            bytes([MSG_PING]) + self.node_id
+            + struct.pack("<H", self.tcp_port), self.boot_addr)
+        try:
+            self.sock.recvfrom(64)  # PONG
+            self.sock.sendto(bytes([MSG_FIND]), self.boot_addr)
+            data, _ = self.sock.recvfrom(65536)
+        except OSError:
+            return []
+        if not data or data[0] != MSG_NODES:
+            return []
+        (n,) = struct.unpack_from("<H", data, 1)
+        fresh = []
+        off = 3
+        for _ in range(n):
+            nid, port, ip = RECORD.unpack_from(data, off)
+            off += RECORD.size
+            if nid in self.known:
+                continue
+            self.known.add(nid)
+            host = socket.inet_ntoa(ip)
+            fresh.append((nid, port, host))
+            try:
+                self.dial(host, port)
+                self.log.info("discovered peer", node=nid.hex(), port=port)
+            except OSError:
+                self.known.discard(nid)  # retry on the next round
+        return fresh
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.sock.close()

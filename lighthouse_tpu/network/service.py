@@ -73,6 +73,10 @@ class NetworkNode:
         bus.subscribe(TOPIC_BLOCK, self._block_handler)
         self._att_handler = self._on_gossip_attestation
         bus.subscribe(TOPIC_AGGREGATE, self._att_handler)
+        # Attestation subnets this node processes (`attestation_service
+        # .rs` subscriptions: aggregation duties + persistent subnets).
+        self.subnets: set[int] = set()
+        self._subnet_handlers: dict[int, Callable] = {}
 
     # -- publishing ----------------------------------------------------------
 
@@ -85,6 +89,30 @@ class NetworkNode:
     def publish_attestations(self, atts: List) -> None:
         self.bus.publish(TOPIC_AGGREGATE, atts, exclude=self._att_handler)
         self._on_gossip_attestation(atts)
+
+    # -- attestation subnets --------------------------------------------------
+
+    def subscribe_subnet(self, subnet: int) -> None:
+        """Join one of the 64 attestation subnets (`attestation_service.rs`
+        subscribe_to_subnet): only subscribed subnets reach this node's
+        processor — the bandwidth-isolation role of gossipsub meshes."""
+        subnet = int(subnet) % ATTESTATION_SUBNET_COUNT
+        if subnet in self.subnets:
+            return
+        self.subnets.add(subnet)
+        handler = self._on_gossip_attestation
+        self._subnet_handlers[subnet] = handler
+        self.bus.subscribe(TOPIC_ATTESTATION_SUBNET.format(subnet), handler)
+
+    def publish_attestation_to_subnet(self, att, subnet: int) -> None:
+        """Unaggregated attestation → its subnet topic (the VC's
+        `publish_attestations` route before aggregation)."""
+        subnet = int(subnet) % ATTESTATION_SUBNET_COUNT
+        topic = TOPIC_ATTESTATION_SUBNET.format(subnet)
+        handler = self._subnet_handlers.get(subnet)
+        self.bus.publish(topic, [att], exclude=handler)
+        if subnet in self.subnets:
+            self._on_gossip_attestation([att])
 
     # -- gossip handlers → processor queues ----------------------------------
 

@@ -220,6 +220,13 @@ class WireNetwork:
         # Outbound gossip: re-publish local publishes onto the wire.
         self.bus.subscribe(TOPIC_BLOCK, self._wire_block_out)
         self.bus.subscribe(TOPIC_AGGREGATE, self._wire_atts_out)
+        from .service import ATTESTATION_SUBNET_COUNT, \
+            TOPIC_ATTESTATION_SUBNET
+        for subnet in range(ATTESTATION_SUBNET_COUNT):
+            topic = TOPIC_ATTESTATION_SUBNET.format(subnet)
+            self.bus.subscribe(
+                topic, lambda atts, _t=topic: self._flood(
+                    _t, _enc_atts(self.T, atts)))
         self._listener = socket.create_server(("127.0.0.1", port))
         self.port = self._listener.getsockname()[1]
         self._accept_t = threading.Thread(target=self._accept_loop,
@@ -248,6 +255,36 @@ class WireNetwork:
     def dial(self, port: int, host: str = "127.0.0.1") -> RemotePeer:
         sock = socket.create_connection((host, port))
         return self._add_conn(sock)
+
+    def connect_unique(self, host: str, port: int) -> Optional[RemotePeer]:
+        """Dial unless the target turns out to be this node or an
+        already-connected peer: a Status round-trip identifies the remote
+        before keeping the connection, so mutual discovery (A and B both
+        seeing each other's record) converges on ~one connection per pair
+        instead of flooding every frame twice.  A simultaneous-dial race
+        can still leave a transient duplicate; gossip stays correct either
+        way via the seen-hash dedup in ``_flood``."""
+        peer = self.dial(port, host)
+        peer.head_slot()  # Status: fills peer.peer_id
+        pid = peer.peer_id
+        if pid is not None:
+            dup = pid == self.node_id or any(
+                p is not peer and p.peer_id == pid
+                for p in self.node.peers)
+            if dup:
+                peer._conn.close()
+                return None
+        return peer
+
+    def discover(self, boot_host: str, boot_port: int,
+                 interval: float = 2.0):
+        """Join the network via a boot node (`discovery/mod.rs` role):
+        registers this endpoint and dials every fresh record."""
+        from .discovery import DiscoveryService
+        return DiscoveryService(
+            self.node_id, self.port, (boot_host, boot_port),
+            dial=self.connect_unique, interval=interval,
+            log=self.node.log)
 
     def close(self) -> None:
         try:
@@ -312,6 +349,13 @@ class WireNetwork:
                 self.node._on_gossip_block(_dec_block(self.T, body))
             elif topic == TOPIC_AGGREGATE:
                 self.node._on_gossip_attestation(_dec_atts(self.T, body))
+            elif topic.startswith("beacon_attestation_"):
+                # Deliver only subscribed subnets (forwarding above keeps
+                # the mesh connected; a real gossipsub would not even
+                # forward unsubscribed topics).
+                subnet = int(topic.rsplit("_", 1)[-1])
+                if subnet in self.node.subnets:
+                    self.node._on_gossip_attestation(_dec_atts(self.T, body))
         elif kind == KIND_REQUEST:
             (req_id,) = struct.unpack_from("<I", payload, 0)
             method = payload[4]

@@ -120,3 +120,17 @@ def get_attesting_indices(state, data, aggregation_bits, preset) -> np.ndarray:
     if bits.shape[0] != len(committee):
         raise ValueError("aggregation bitlist length != committee size")
     return committee[bits]
+
+
+def compute_subnet_for_attestation(state, att_data, preset) -> int:
+    """Gossip subnet of an unaggregated attestation
+    (spec `compute_subnet_for_attestation`; the reference's
+    `lighthouse_network` subnet_id) — committee offset within the epoch
+    modulo the 64 attestation subnets."""
+    slot = int(att_data.slot)
+    committees_per_slot = get_committee_count_per_slot(
+        state, slot // preset.SLOTS_PER_EPOCH, preset)
+    slots_since_epoch_start = slot % preset.SLOTS_PER_EPOCH
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return int((committees_since_epoch_start + int(att_data.index))
+               % preset.ATTESTATION_SUBNET_COUNT)

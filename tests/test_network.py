@@ -139,3 +139,33 @@ def test_slot_clocks():
                             seconds_per_slot=12)
     assert s.now() == 2
     assert 0 < s.duration_to_next_slot() <= 12
+
+
+def test_attestation_subnet_routing():
+    """Unaggregated attestations reach only subscribed subnets
+    (`attestation_service.rs` subscriptions + spec
+    compute_subnet_for_attestation)."""
+    from lighthouse_tpu.state_transition.committees import (
+        compute_subnet_for_attestation)
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    bus = GossipBus()
+    a = _make_node(h, bus, "a")
+    b = _make_node(h, bus, "b")
+    c = _make_node(h, bus, "c")
+
+    sb = h.build_block()
+    h.apply_block(sb)
+    atts = h.attestations_for_slot(h.state, int(sb.message.slot) - 1)
+    att = atts[0]
+    subnet = compute_subnet_for_attestation(h.state, att.data, h.preset)
+    assert 0 <= subnet < 64
+    b.subscribe_subnet(subnet)           # b cares about this committee
+    c.subscribe_subnet((subnet + 1) % 64)  # c does not
+    for n in (a, b, c):
+        n.chain.per_slot_task(int(att.data.slot) + 1)
+    a.publish_attestation_to_subnet(att, subnet)
+    b.processor.run_until_idle()
+    c.processor.run_until_idle()
+    assert len(b.chain.op_pool.attestations) > 0
+    assert len(c.chain.op_pool.attestations) == 0

@@ -117,3 +117,37 @@ def test_blocks_by_root_over_wire_and_parent_lookup():
     finally:
         a.close()
         b.close()
+
+
+def test_boot_node_discovery_mesh():
+    """Three nodes that only know the boot node's UDP address find each
+    other and converge over gossip (`boot_node` + `discovery/` roles)."""
+    from lighthouse_tpu.network.discovery import BootNode
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    boot = BootNode()
+    nets = [_node(h) for _ in range(3)]
+    discos = []
+    try:
+        for net in nets:
+            discos.append(net.discover("127.0.0.1", boot.port,
+                                       interval=0.2))
+        # every node learns both others
+        assert _wait(lambda: all(len(n.node.peers) >= 2 for n in nets))
+        sb = h.build_block()
+        h.apply_block(sb)
+        for n in nets:
+            n.node.chain.per_slot_task(int(sb.message.slot))
+        nets[0].publish_block(sb)
+        assert _wait(lambda: all(
+            (n.node.processor.run_until_idle() or True)
+            and n.node.chain.head.slot == int(sb.message.slot)
+            for n in nets))
+        roots = {n.node.chain.head.root for n in nets}
+        assert len(roots) == 1
+    finally:
+        for d in discos:
+            d.close()
+        for n in nets:
+            n.close()
+        boot.close()
