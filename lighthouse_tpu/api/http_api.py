@@ -13,7 +13,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ..common.metrics import REGISTRY
 from ..ssz.json import to_json
@@ -180,6 +180,49 @@ class HttpApiServer:
                 h._json({"code": 400, "message": str(e)}, 400)
             else:
                 h._json({"data": duties})
+        elif path == "/eth/v1/validator/attestation_data":
+            from ..validator_client.beacon_node import InProcessBeaconNode
+            qs = parse_qs(urlparse(h.path).query)
+            try:
+                slot = int(qs["slot"][0])
+                # Attestations are produced for the current slot; a huge
+                # slot would otherwise advance a full state copy
+                # unboundedly on the API thread.
+                now = max(chain.current_slot(), chain.head.slot)
+                if slot > now + 1:
+                    raise ValueError(
+                        f"attestation data only up to slot {now + 1}")
+                data = InProcessBeaconNode(chain).attestation_data(
+                    slot, int(qs["committee_index"][0]))
+            except (KeyError, ValueError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+            else:
+                h._json({"data": to_json(data)})
+        elif path == "/eth/v1/config/spec":
+            import dataclasses
+            out = {}
+            for f in dataclasses.fields(chain.spec):
+                v = getattr(chain.spec, f.name)
+                out[f.name.upper()] = ("0x" + v.hex()
+                                       if isinstance(v, bytes) else str(v))
+            h._json({"data": out})
+        elif path.startswith("/eth/v1/beacon/light_client/bootstrap/"):
+            from ..light_client import LightClientServer
+            root_hex = path.split("/")[-1]
+            try:
+                root = bytes.fromhex(root_hex[2:] if root_hex.startswith(
+                    "0x") else root_hex)
+                bs = LightClientServer(chain).bootstrap(root)
+            except (ValueError, KeyError) as e:
+                h._json({"code": 404, "message": str(e)}, 404)
+            else:
+                h._json({"data": {
+                    "header": {"beacon": to_json(bs.header)},
+                    "current_sync_committee": to_json(
+                        bs.current_sync_committee),
+                    "current_sync_committee_branch":
+                        ["0x" + b.hex()
+                         for b in bs.current_sync_committee_branch]}})
         elif path == "/eth/v1/events":
             self._serve_events(h)
         elif path == "/metrics":
@@ -285,6 +328,72 @@ class HttpApiServer:
             signed = chain.T.signed_block_cls(fork).deserialize(body)
             chain.per_slot_task(int(signed.message.slot))
             chain.process_block(signed, is_timely=True)
+            h._json({})
+        elif path.startswith("/eth/v1/validator/duties/attester/"):
+            from ..validator_client.beacon_node import InProcessBeaconNode
+            try:
+                epoch = int(path.split("/")[-1])
+                # Same unauthenticated-amplification gate as proposer
+                # duties: only the current/next wall-clock epoch, else a
+                # far-future epoch drives process_slots for billions of
+                # slots on the API thread.
+                spe = chain.preset.SLOTS_PER_EPOCH
+                now_epoch = max(chain.current_slot(),
+                                chain.head.slot) // spe
+                if not now_epoch <= epoch <= now_epoch + 1:
+                    raise ValueError(
+                        f"attester duties only for epochs {now_epoch}.."
+                        f"{now_epoch + 1}")
+                indices = [int(i) for i in json.loads(body)]
+                duties = InProcessBeaconNode(chain).attester_duties(
+                    epoch, indices)
+            except (ValueError, KeyError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            reg = chain.head.state.validators
+            h._json({"data": [{
+                "pubkey": "0x" + reg.pubkey[d.validator_index]
+                .tobytes().hex(),
+                "validator_index": str(d.validator_index),
+                "committee_index": str(d.committee_index),
+                "committee_length": str(d.committee_length),
+                "validator_committee_index": str(d.committee_position),
+                "slot": str(d.slot)} for d in duties]})
+        elif path.startswith("/eth/v1/validator/duties/sync/"):
+            from ..validator_client.beacon_node import InProcessBeaconNode
+            try:
+                indices = [int(i) for i in json.loads(body)]
+                positions = InProcessBeaconNode(
+                    chain).sync_committee_positions(indices)
+            except (ValueError, KeyError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            reg = chain.head.state.validators
+            h._json({"data": [{
+                "pubkey": "0x" + reg.pubkey[vi].tobytes().hex(),
+                "validator_index": str(vi),
+                "validator_sync_committee_indices":
+                    [str(p) for p in pos]}
+                for vi, pos in positions.items() if pos]})
+        elif path == "/eth/v1/beacon/pool/attestations":
+            from ..ssz.json import from_json
+            try:
+                atts = [from_json(chain.T.Attestation, a)
+                        for a in json.loads(body)]
+            except (ValueError, KeyError, TypeError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            chain.process_attestation_batch(atts)
+            h._json({})
+        elif path == "/eth/v1/beacon/pool/voluntary_exits":
+            from ..ssz.json import from_json
+            try:
+                exit_ = from_json(chain.T.SignedVoluntaryExit,
+                                  json.loads(body))
+            except (ValueError, KeyError, TypeError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            chain.op_pool.insert_voluntary_exit(exit_)
             h._json({})
         else:
             h._json({"code": 404, "message": "unknown route"}, 404)

@@ -164,6 +164,17 @@ def cmd_beacon_node(args) -> int:
             km.start()
             print(f"keymanager API up: http://127.0.0.1:{km.port} "
                   f"token={km.token}")
+    # Graceful-shutdown service (`environment`'s shutdown-signal task +
+    # `beacon_chain` persist-on-drop): SIGTERM must reach the persist
+    # path below, not kill the process mid-write.
+    import signal
+
+    def _term(_sig, _frm):
+        raise SystemExit(0)
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass  # non-main thread (embedded use) — rely on finally
     # Devnet clock: start at the next slot AFTER the (possibly resumed)
     # head — restarting at slot 0 against a resumed head would have the VC
     # proposing slot-1 blocks onto a later state.

@@ -143,3 +143,62 @@ def test_validator_monitor(api):
     assert sum(v["blocks_proposed"] for v in out) == 3
     assert any(v["attestations_included"] for v in out)
     assert all(v["balance"] is not None for v in out)
+
+
+def _post(srv, path, obj):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_attester_and_sync_duties_routes(api):
+    h, chain, srv = api
+    out = _post(srv, "/eth/v1/validator/duties/attester/0",
+                ["0", "1", "2"])
+    assert len(out["data"]) == 3
+    d = out["data"][0]
+    assert set(d) >= {"pubkey", "validator_index", "committee_index",
+                      "slot", "committee_length"}
+    sync = _post(srv, "/eth/v1/validator/duties/sync/0",
+                 [str(i) for i in range(16)])
+    # minimal preset: 16 validators fill the 32-seat sync committee
+    assert len(sync["data"]) > 0
+    assert sync["data"][0]["validator_sync_committee_indices"]
+
+
+def test_attestation_data_and_pool_submit(api):
+    h, chain, srv = api
+    data = _get(srv, "/eth/v1/validator/attestation_data"
+                     "?slot=0&committee_index=0")
+    assert data["data"]["slot"] == "0"
+    # produce a block so slot-0 attestations exist, then submit them back
+    sb = h.build_block()
+    h.apply_block(sb)
+    chain.per_slot_task(int(sb.message.slot))
+    chain.process_block(sb)
+    atts = h.attestations_for_slot(h.state, int(sb.message.slot) - 1)
+    from lighthouse_tpu.ssz.json import to_json
+    chain.per_slot_task(int(sb.message.slot) + 1)
+    out = _post(srv, "/eth/v1/beacon/pool/attestations",
+                [to_json(a) for a in atts])
+    assert out == {}
+    pool = _get(srv, "/eth/v1/beacon/pool/attestations")
+    assert len(pool["data"]) > 0
+
+
+def test_config_spec_route(api):
+    h, chain, srv = api
+    spec = _get(srv, "/eth/v1/config/spec")
+    assert "SECONDS_PER_SLOT" in spec["data"] or len(spec["data"]) > 0
+
+
+def test_light_client_bootstrap_route(api):
+    h, chain, srv = api
+    bs = _get(srv, "/eth/v1/beacon/light_client/bootstrap/"
+                   "0x" + chain.head.root.hex())
+    assert "current_sync_committee" in bs["data"]
+    assert len(bs["data"]["current_sync_committee_branch"]) > 0
+    assert bs["data"]["header"]["beacon"]["slot"] == "0"
