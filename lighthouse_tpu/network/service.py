@@ -26,6 +26,7 @@ TOPIC_ATTESTATION_SUBNET = "beacon_attestation_{}"
 TOPIC_EXIT = "voluntary_exit"
 TOPIC_PROPOSER_SLASHING = "proposer_slashing"
 TOPIC_ATTESTER_SLASHING = "attester_slashing"
+TOPIC_SYNC_COMMITTEE = "sync_committee_message"
 ATTESTATION_SUBNET_COUNT = 64
 
 
@@ -77,6 +78,8 @@ class NetworkNode:
         # .rs` subscriptions: aggregation duties + persistent subnets).
         self.subnets: set[int] = set()
         self._subnet_handlers: dict[int, Callable] = {}
+        self._sync_handler = self._on_gossip_sync_messages
+        bus.subscribe(TOPIC_SYNC_COMMITTEE, self._sync_handler)
 
     # -- publishing ----------------------------------------------------------
 
@@ -89,6 +92,24 @@ class NetworkNode:
     def publish_attestations(self, atts: List) -> None:
         self.bus.publish(TOPIC_AGGREGATE, atts, exclude=self._att_handler)
         self._on_gossip_attestation(atts)
+
+    # -- sync-committee gossip ------------------------------------------------
+
+    def publish_sync_messages(self, slot: int, block_root: bytes,
+                              votes: List) -> None:
+        """Sync-committee messages → gossip + local pool
+        (`sync_committee_verification` topic flow).  ``votes`` is a list
+        of (positions, signature_bytes)."""
+        msg = (int(slot), bytes(block_root), list(votes))
+        self.bus.publish(TOPIC_SYNC_COMMITTEE, msg,
+                         exclude=self._sync_handler)
+        self._on_gossip_sync_messages(msg)
+
+    def _on_gossip_sync_messages(self, msg) -> None:
+        slot, block_root, votes = msg
+        for positions, sig in votes:
+            self.chain.sync_message_pool.insert(
+                slot, block_root, positions, sig)
 
     # -- attestation subnets --------------------------------------------------
 

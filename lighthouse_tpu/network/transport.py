@@ -38,6 +38,7 @@ from .service import (
     NetworkNode,
     TOPIC_AGGREGATE,
     TOPIC_BLOCK,
+    TOPIC_SYNC_COMMITTEE,
 )
 
 _FORK_IDS = {f: i for i, f in enumerate(ForkName)}
@@ -82,6 +83,30 @@ def _dec_block_list(T, data: bytes) -> List:
         out.append(_dec_block(T, data[off:off + ln]))
         off += ln
     return out
+
+
+def _enc_sync(msg) -> bytes:
+    slot, root, votes = msg
+    out = [struct.pack("<Q32sH", slot, root, len(votes))]
+    for positions, sig in votes:
+        out.append(struct.pack("<H", len(positions)))
+        out.append(b"".join(struct.pack("<H", int(p)) for p in positions))
+        out.append(bytes(sig))
+    return b"".join(out)
+
+
+def _dec_sync(data: bytes):
+    slot, root, n = struct.unpack_from("<Q32sH", data, 0)
+    off = 42
+    votes = []
+    for _ in range(n):
+        (npos,) = struct.unpack_from("<H", data, off)
+        off += 2
+        positions = list(struct.unpack_from("<%dH" % npos, data, off))
+        off += 2 * npos
+        votes.append((positions, data[off:off + 96]))
+        off += 96
+    return (slot, root, votes)
 
 
 def _enc_atts(T, atts: List) -> bytes:
@@ -222,6 +247,9 @@ class WireNetwork:
         self.bus.subscribe(TOPIC_AGGREGATE, self._wire_atts_out)
         from .service import ATTESTATION_SUBNET_COUNT, \
             TOPIC_ATTESTATION_SUBNET
+        self.bus.subscribe(
+            TOPIC_SYNC_COMMITTEE,
+            lambda msg: self._flood(TOPIC_SYNC_COMMITTEE, _enc_sync(msg)))
         for subnet in range(ATTESTATION_SUBNET_COUNT):
             topic = TOPIC_ATTESTATION_SUBNET.format(subnet)
             self.bus.subscribe(
@@ -349,6 +377,8 @@ class WireNetwork:
                 self.node._on_gossip_block(_dec_block(self.T, body))
             elif topic == TOPIC_AGGREGATE:
                 self.node._on_gossip_attestation(_dec_atts(self.T, body))
+            elif topic == TOPIC_SYNC_COMMITTEE:
+                self.node._on_gossip_sync_messages(_dec_sync(body))
             elif topic.startswith("beacon_attestation_"):
                 # Deliver only subscribed subnets (forwarding above keeps
                 # the mesh connected; a real gossipsub would not even

@@ -1,0 +1,170 @@
+"""Multi-node local-network simulator — the role of
+``/root/reference/testing/simulator`` (``local_network.rs`` +
+``eth1_sim.rs``): N full nodes with wire networking and discovery, the
+validator set split across per-node validator clients, a stepped clock,
+and assertions on convergence and finalization.
+
+Used by ``tests/test_simulator.py`` and runnable directly:
+
+    python -m lighthouse_tpu.testing.simulator --nodes 3 --slots 12
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..beacon_chain import BeaconChain
+from ..network.discovery import BootNode
+from ..network.transport import WireNetwork
+from ..store import HotColdDB
+from ..state_transition.genesis import interop_secret_key
+from ..validator_client import (
+    InProcessBeaconNode,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+class _GossipingBeaconNode(InProcessBeaconNode):
+    """VC-facing node handle that broadcasts productions over the wire
+    (the reference VC talks HTTP to its BN, which gossips; in-process we
+    splice the gossip in at the same point — `publish_blocks.rs`)."""
+
+    def __init__(self, net: WireNetwork):
+        super().__init__(net.node.chain)
+        self._net = net
+
+    def publish_block(self, signed_block) -> bytes:
+        root = super().publish_block(signed_block)  # own import first
+        self._net._wire_block_out(signed_block)
+        return root
+
+    def submit_attestations(self, atts: List) -> None:
+        super().submit_attestations(atts)
+        if atts:
+            self._net._wire_atts_out(list(atts))
+
+
+@dataclass
+class SimNode:
+    net: WireNetwork
+    vc: Optional[ValidatorClient]
+    discovery: object
+
+    @property
+    def chain(self) -> BeaconChain:
+        return self.net.node.chain
+
+
+class Simulator:
+    def __init__(self, n_nodes: int = 3, n_validators: int = 16,
+                 preset=None):
+        from .harness import StateHarness
+        from ..types.presets import MINIMAL
+
+        self.preset = preset or MINIMAL
+        self.harness = StateHarness(n_validators=n_validators,
+                                    preset=self.preset)
+        h = self.harness
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        genesis_root = hdr.tree_hash_root()
+
+        self.boot = BootNode()
+        self.nodes: List[SimNode] = []
+        share = n_validators // n_nodes
+        for i in range(n_nodes):
+            chain = BeaconChain(
+                store=HotColdDB.memory(h.preset, h.spec, h.T),
+                genesis_state=h.state.copy(),
+                genesis_block_root=genesis_root,
+                preset=h.preset, spec=h.spec, T=h.T)
+            net = WireNetwork(chain, name=f"node{i}")
+            disco = net.discover("127.0.0.1", self.boot.port, interval=0.2)
+            lo = i * share
+            hi = n_validators if i == n_nodes - 1 else lo + share
+            vstore = ValidatorStore()
+            for v in range(lo, hi):
+                vstore.add_validator(interop_secret_key(v), index=v)
+            vc = ValidatorClient(vstore, [_GossipingBeaconNode(net)],
+                                 h.preset)
+            self.nodes.append(SimNode(net=net, vc=vc, discovery=disco))
+
+    def wait_for_mesh(self, timeout: float = 20.0) -> bool:
+        """Every node discovers every other node."""
+        want = len(self.nodes) - 1
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(len(n.net.node.peers) >= want for n in self.nodes):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def run_slot(self, slot: int) -> None:
+        """One slot: tick every chain, drive every VC, drain queues."""
+        for n in self.nodes:
+            n.chain.per_slot_task(slot)
+        for n in self.nodes:
+            n.vc.on_slot(slot)
+        # Let gossip propagate and queues drain (bounded settle loop).
+        for _ in range(40):
+            busy = False
+            for n in self.nodes:
+                if n.net.node.processor.run_until_idle():
+                    busy = True
+            if not busy:
+                time.sleep(0.02)
+                drained = all(not n.net.node.processor.run_until_idle()
+                              for n in self.nodes)
+                if drained:
+                    break
+
+    def run(self, n_slots: int) -> None:
+        for slot in range(1, n_slots + 1):
+            self.run_slot(slot)
+
+    # -- assertions ----------------------------------------------------------
+
+    def heads(self) -> set:
+        return {n.chain.head.root for n in self.nodes}
+
+    def finalized_epochs(self) -> List[int]:
+        return [n.chain.fork_choice.finalized_checkpoint[0]
+                for n in self.nodes]
+
+    def close(self) -> None:
+        for n in self.nodes:
+            n.discovery.close()
+            n.net.close()
+        self.boot.close()
+
+
+def main() -> int:
+    import argparse
+    from ..crypto import bls as B
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--validators", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=12)
+    args = ap.parse_args()
+
+    B.set_backend("fake")
+    sim = Simulator(n_nodes=args.nodes, n_validators=args.validators)
+    try:
+        assert sim.wait_for_mesh(), "discovery mesh failed"
+        sim.run(args.slots)
+        heads = sim.heads()
+        fins = sim.finalized_epochs()
+        print(f"heads={len(heads)} finalized_epochs={fins}")
+        ok = len(heads) == 1 and min(fins) >= 1
+        print("CONVERGED + FINALIZED" if ok else "FAILED")
+        return 0 if ok else 1
+    finally:
+        sim.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,3 +28,43 @@ jax.config.update("jax_compilation_cache_dir",
                   os.path.join(os.path.dirname(__file__), os.pardir,
                                ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): hard SIGALRM bound — a cold-compile hang "
+        "fails fast instead of eating the suite (VERDICT r3 weak #7)")
+
+
+def pytest_runtest_setup(item):
+    import faulthandler
+    import signal
+    marker = item.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker else 900  # suite-wide default
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {seconds}s (cold-compile hang?)")
+
+    try:
+        signal.signal(signal.SIGALRM, _expired)
+        signal.alarm(seconds)
+    except ValueError:
+        pass  # non-main thread runner
+    # SIGALRM only fires once Python bytecode runs again; a hang INSIDE a
+    # blocking C++ compile call never re-enters the interpreter.  The
+    # faulthandler watchdog thread is the real backstop: it dumps every
+    # stack and hard-exits, which is what turns a stuck cold compile into
+    # a visible failure instead of an eaten suite.
+    faulthandler.dump_traceback_later(seconds + 60, exit=True)
+
+
+def pytest_runtest_teardown(item):
+    import faulthandler
+    import signal
+    faulthandler.cancel_dump_traceback_later()
+    try:
+        signal.alarm(0)
+    except ValueError:
+        pass

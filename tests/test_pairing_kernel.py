@@ -37,11 +37,20 @@ def _g2_planes(pts, M):
     return out
 
 
+def _lane_fq12(planes, lane):
+    """(384, M) device blocks → host Fq12 tuple for one lane (the old
+    tpu_backend._lane_fq12, now test-local — production folds on-device)."""
+    from lighthouse_tpu.crypto import limb_field as LF
+    c = [LF.from_mont(np.asarray(planes[i * 32:i * 32 + 26, lane]))
+         for i in range(12)]
+    return (((c[0], c[1]), (c[2], c[3]), (c[4], c[5])),
+            ((c[6], c[7]), (c[8], c[9]), (c[10], c[11])))
+
+
 def test_miller_kernel_matches_host_oracle():
     import jax.numpy as jnp
     from lighthouse_tpu.crypto import curve as C, fields as F, pairing as HP
     from lighthouse_tpu.crypto import pairing_kernel as PK
-    from lighthouse_tpu.crypto.tpu_backend import _lane_fq12
 
     M = 128
     p1 = [C.g1_mul(C.G1_GEN, 100 + i) for i in range(3)]

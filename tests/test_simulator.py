@@ -1,0 +1,30 @@
+"""Multi-node simulator: discovery mesh + gossip + VCs → finality
+(`testing/simulator` role — the reference's `eth1_sim` checks the same
+invariants: all nodes on one head, finalized checkpoint advancing)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.testing.simulator import Simulator
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+@pytest.mark.timeout(300)
+def test_three_node_network_finalizes():
+    sim = Simulator(n_nodes=3, n_validators=16)
+    try:
+        assert sim.wait_for_mesh()
+        sim.run(32)  # 4 minimal epochs: justify 1..2, finalize 2
+        assert len(sim.heads()) == 1
+        assert min(sim.finalized_epochs()) >= 2
+        # every node's op pool pruned to post-finalization content only
+        for n in sim.nodes:
+            assert n.chain.fork_choice.finalized_checkpoint[0] >= 2
+    finally:
+        sim.close()

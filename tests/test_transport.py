@@ -151,3 +151,22 @@ def test_boot_node_discovery_mesh():
         for n in nets:
             n.close()
         boot.close()
+
+
+def test_sync_committee_messages_cross_wire():
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    a = _node(h)
+    b = _node(h)
+    try:
+        b.dial(a.port)
+        assert _wait(lambda: a.node.peers)
+        root = a.node.chain.head.root
+        sig = b"\x11" * 96
+        a.node.publish_sync_messages(3, root, [([2, 5], sig)])
+        assert _wait(lambda: (3, bytes(root))
+                     in b.node.chain.sync_message_pool._votes)
+        entry = b.node.chain.sync_message_pool._votes[(3, bytes(root))]
+        assert entry == {2: sig, 5: sig}
+    finally:
+        a.close()
+        b.close()
