@@ -1,30 +1,38 @@
 """Headline benchmark: batched BLS aggregate-verify + registry Merkleization
-on TPU — the two north-star metrics (`BASELINE.md` Target table).
+on TPU — the north-star metrics (`BASELINE.md` Target table).
 
-Primary metric: ``verify_signature_sets`` throughput through the production
-Pallas pipeline (prepare → Miller → product kernels + one shared host final
-exponentiation), on 256 single-key signature sets with REAL BLS signatures.
-The correctness gate runs the same batch plus a tampered batch and requires
-accept/reject before timing.
+Primary metric: ``verify_signature_sets`` throughput through the fused
+device pipeline (pubkey-table gather → hash-to-curve kernel → prepare →
+Miller → product fold → on-device final exponentiation; ONE host sync per
+call), on **1024 aggregate signature sets** (BASELINE row 1's workload):
+64 distinct messages, 2^14 distinct pubkeys (16 signers per set) — nothing
+about the crypto is memoised away (VERDICT r3 weak #8): message
+hash-to-curve runs on-device every call; the device pubkey table is the
+``validator_pubkey_cache.rs`` role and is reported warm AND cold.
 
-Methodology notes (all numbers in the JSON line):
+Also measured (BASELINE rows 2-5 + latency tier):
 
-- ``vs_baseline`` compares against a **native single-core blst estimate**
-  of 0.7 ms/set for ``verify_multiple_aggregate_signatures`` (1 Miller loop
-  + G2 RLC scalar-mul + share of final exp per set; supranational's
-  published figures put a full 2-pairing verify at ~1.2 ms/core).  The
-  reference parallelises with rayon, so divide by core count for a
-  multi-core comparison.
-- Message hashing (hash-to-curve) is host-side SSWU, memoised per message;
-  its cost is reported separately (``hash_to_g2_host_ms_each``) — the
-  per-slot workload hashes ~64 distinct messages, the batch here reuses 32.
-- ``registry_htr_ms``: full ``ValidatorRegistry.hash_tree_root`` at 2^21
-  validators — per-record 8-leaf trees (batched device hash64) + the fused
-  Pallas sub-tree reduction — vs a 40 ns/hash single-SHA-NI-core estimate
-  over the same ~19M hashes.
-- ``state_root_incremental_ms``: per-slot `BeaconState` root after mutating
-  100 validators + 100 balances at 2^20-validator scale, through the
-  incremental tree-hash cache (round 2 paid ~150 ms full recompute here).
+- ``single_set_verify_ms`` — one proposer-signature set through the same
+  pipeline (the gossip-block check, `block_verification.py`).  Note the
+  axon tunnel contributes ~100 ms fixed roundtrip latency per sync.
+- ``fast_aggregate_verify_512x256_ms`` — 256 sets × 512 shared pubkeys
+  (sync-committee shape, BASELINE row 4).
+- ``registry_htr_ms`` — fused-Pallas `hash_tree_root` of a 2^21-validator
+  registry vs a 40 ns/hash single-SHA-NI-core estimate.
+- ``state_root_cold_ms`` / ``state_root_incremental_ms`` — full
+  `BeaconState` root at 2^20 validators, cold and after 100-validator
+  mutations (reference: `tree_hash_cache.rs`).
+- ``block_transition_ms`` — Capella block with 128 attestations applied
+  to a 2^14-validator mainnet state, per-phase (BASELINE row 3;
+  `lcli/src/transition_blocks.rs:229`).
+- ``op_pool_pack_100k_ms`` — max-cover packing over 100k pooled
+  attestations (BASELINE row 5).
+
+``vs_baseline`` compares against a **native single-core blst estimate** of
+0.7 ms/set for ``verify_multiple_aggregate_signatures`` (1 Miller loop +
+G2 RLC scalar-mul + share of final exp per set; supranational's published
+figures put a full 2-pairing verify at ~1.2 ms/core).  The reference
+parallelises with rayon, so divide by core count for multi-core.
 
 Prints ONE JSON line:
 ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}``.
@@ -34,7 +42,6 @@ from __future__ import annotations
 
 import faulthandler
 import json
-import os
 import signal
 import sys
 import time
@@ -45,7 +52,9 @@ import numpy as np
 
 BLST_EST_MS_PER_SET = 0.7      # single-core native estimate (see docstring)
 NATIVE_NS_PER_HASH = 40.0      # single SHA-NI core, 64-byte message
-N_SETS = 256
+N_SETS = 1024                  # BASELINE row 1: 1024 attestation sets
+KEYS_PER_SET = 16              # → 2^14 distinct pubkeys
+N_MSGS = 64                    # distinct messages (≥ one per committee)
 REG_LOG2 = 21                  # registry Merkle scale
 STATE_LOG2 = 20                # incremental state-root scale
 RUNS = 3
@@ -53,31 +62,34 @@ RUNS = 3
 
 def _bls_bench() -> dict:
     from lighthouse_tpu.crypto import bls
-    from lighthouse_tpu.crypto import tpu_backend  # noqa: F401 (registers)
+    from lighthouse_tpu.crypto import tpu_backend as TB  # noqa (registers)
+    from lighthouse_tpu.crypto.fields import R
 
     tpu = bls._BACKENDS["tpu"]
-    sks = [bls.SecretKey(0x1000 + i) for i in range(8)]
+
+    t_setup = time.perf_counter()
+    sk_ints = [0x10000 + 7 * i for i in range(N_SETS * KEYS_PER_SET)]
+    sks = [bls.SecretKey(v) for v in sk_ints]
     pks = [k.public_key() for k in sks]
-    msgs = [b"bench-msg-%02d" % i for i in range(32)]
-
-    from lighthouse_tpu.crypto.hash_to_curve import hash_to_g2
-    hash_to_g2(b"bench-warm-0")  # import/constant warmup outside the timing
-    t0 = time.perf_counter()
-    hash_to_g2(b"bench-warm-1")
-    hash_ms = (time.perf_counter() - t0) * 1e3
-
+    msgs = [b"att-data-%03d" % i for i in range(N_MSGS)]
     sets = []
     for i in range(N_SETS):
-        m = msgs[i % len(msgs)]
-        k = sks[i % len(sks)]
-        sets.append(bls.SignatureSet(k.sign(m), [pks[i % len(sks)]], m))
+        keys = pks[i * KEYS_PER_SET:(i + 1) * KEYS_PER_SET]
+        vals = sk_ints[i * KEYS_PER_SET:(i + 1) * KEYS_PER_SET]
+        m = msgs[i % N_MSGS]
+        # Aggregate-of-16 signature == signature under the summed secret.
+        agg = bls.SecretKey(sum(vals) % R).sign(m)
+        sets.append(bls.SignatureSet(agg, list(keys), m))
+    setup_s = time.perf_counter() - t_setup
 
-    # Correctness gates (also warms every kernel + the hash memo).
+    # Correctness gates (also warms kernels + uploads the pubkey table).
+    t0 = time.perf_counter()
     if not tpu.verify_signature_sets(sets):
         raise RuntimeError("valid batch rejected")
+    cold_ms = (time.perf_counter() - t0) * 1e3
     bad = list(sets)
-    bad[17] = bls.SignatureSet(sets[17].signature, [pks[(17 + 1) % 8]],
-                               msgs[17 % 32])
+    bad[17] = bls.SignatureSet(sets[17].signature, sets[18].signing_keys,
+                               sets[17].message)
     if tpu.verify_signature_sets(bad):
         raise RuntimeError("tampered batch accepted")
 
@@ -88,12 +100,38 @@ def _bls_bench() -> dict:
             raise RuntimeError("valid batch rejected in timing loop")
         ts.append(time.perf_counter() - t0)
     best = min(ts)
+
+    # Latency tier: one single-key set (gossip proposer-signature shape).
+    single = [bls.SignatureSet(sks[0].sign(msgs[0]), [pks[0]], msgs[0])]
+    if not tpu.verify_signature_sets(single):
+        raise RuntimeError("single set rejected")
+    t0 = time.perf_counter()
+    tpu.verify_signature_sets(single)
+    single_ms = (time.perf_counter() - t0) * 1e3
+
+    # BASELINE row 4: fast_aggregate_verify, 512 shared pubkeys × 256 msgs.
+    fam = [b"sync-comm-%03d" % i for i in range(256)]
+    fkeys = pks[:512]
+    fsum = sum(sk_ints[:512]) % R
+    fsets = [bls.SignatureSet(bls.SecretKey(fsum).sign(m), list(fkeys), m)
+             for m in fam]
+    if not tpu.verify_signature_sets(fsets):
+        raise RuntimeError("fast-aggregate batch rejected")
+    t0 = time.perf_counter()
+    tpu.verify_signature_sets(fsets)
+    fam_ms = (time.perf_counter() - t0) * 1e3
+
     sets_per_s = N_SETS / best
     return {
         "sets_per_s": round(sets_per_s, 1),
         "ms_per_set": round(best * 1e3 / N_SETS, 3),
         "batch_ms": round(best * 1e3, 1),
-        "hash_to_g2_host_ms_each": round(hash_ms, 1),
+        "batch_cold_ms": round(cold_ms, 1),
+        "distinct_messages": N_MSGS,
+        "distinct_pubkeys": N_SETS * KEYS_PER_SET,
+        "single_set_verify_ms": round(single_ms, 1),
+        "fast_aggregate_verify_512x256_ms": round(fam_ms, 1),
+        "bls_setup_s": round(setup_s, 1),
     }
 
 
@@ -174,11 +212,6 @@ def _incremental_state_root_bench() -> dict:
         t0 = time.perf_counter()
         state.tree_hash_root()
         ts.append((time.perf_counter() - t0) * 1e3)
-    # Cold-path breakdown recorded by registry_cold_device during the cold
-    # root above: the cold build is ONE fused device dispatch, but it must
-    # first move ~117 MB of host-resident columns through the axon tunnel
-    # (measured ~43 MB/s) — production keeps the columns in HBM
-    # (``registry_htr_ms`` is that shape).
     from lighthouse_tpu.types.validators import LAST_COLD_TIMINGS
     return {
         "state_root_cold_ms": round(cold_ms, 1),
@@ -186,6 +219,64 @@ def _incremental_state_root_bench() -> dict:
         "state_root_cold_compute_ms": LAST_COLD_TIMINGS.get("compute_ms"),
         "state_root_incremental_ms": round(min(ts), 2),
     }
+
+
+def _block_transition_bench() -> dict:
+    """BASELINE row 3: Capella block with 128 attestations, per-phase
+    (state-transition cost; crypto is covered by the sets benchmark)."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MAINNET
+    from lighthouse_tpu.state_transition import SignatureStrategy
+    from lighthouse_tpu.state_transition.per_block import process_block
+    from lighthouse_tpu.state_transition.per_slot import process_slots
+
+    bls.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=1 << 14, preset=MAINNET)
+        # Empty blocks to slot 62 (epoch 1) — state roots skipped during
+        # setup (nothing validates them here) — then a block at 63 packing
+        # one aggregate per committee for the current-epoch slots whose
+        # roots the head state can resolve: 30 slots × 4 committees = 120
+        # attestations (≈ the 128-att BASELINE shape).
+        for _ in range(62):
+            sb = h.build_block(attestations=[], sync_participation=0.0,
+                               compute_state_root=False)
+            h.apply_block(sb, validate_state_root=False)
+        atts = []
+        for s in range(32, 62):
+            atts.extend(h.attestations_for_slot(h.state, s))
+        signed = h.build_block(slot=63, attestations=atts[:128],
+                               sync_participation=0.0,
+                               compute_state_root=False)
+        pre = h.state
+        fork = h.fork_at(int(signed.message.slot))
+        ts = []
+        for _ in range(RUNS):
+            state = pre.copy()
+            t0 = time.perf_counter()
+            state = process_slots(state, int(signed.message.slot), h.preset,
+                                  h.spec, h.T)
+            process_block(state, signed, fork, h.preset, h.spec, h.T,
+                          strategy=SignatureStrategy.NO_VERIFICATION)
+            state.tree_hash_root()
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return {
+            "block_transition_ms": round(min(ts), 1),
+            "block_transition_attestations":
+                len(signed.message.body.attestations),
+        }
+    finally:
+        bls.set_backend("python")
+
+
+def _op_pool_bench() -> dict:
+    """BASELINE row 5: max-cover packing over 100k pooled attestations."""
+    from lighthouse_tpu.op_pool import bench_pack_attestations
+
+    ms, packed = bench_pack_attestations(100_000)
+    return {"op_pool_pack_100k_ms": round(ms, 1),
+            "op_pool_packed": packed}
 
 
 def main() -> None:
@@ -198,6 +289,8 @@ def main() -> None:
     bls = _bls_bench()
     reg = _registry_htr_bench()
     inc = _incremental_state_root_bench()
+    blk = _block_transition_bench()
+    pool = _op_pool_bench()
 
     out = {
         "metric": f"bls_batch_verify_{N_SETS}_sets",
@@ -206,8 +299,9 @@ def main() -> None:
         "vs_baseline": round(
             bls["sets_per_s"] / (1e3 / BLST_EST_MS_PER_SET), 3),
         "baseline": f"blst single-core estimate {BLST_EST_MS_PER_SET} ms/set",
-        **bls, **reg, **inc,
+        **bls, **reg, **inc, **blk, **pool,
         "correctness": "valid batch accepted, tampered batch rejected; "
+                       "device hash-to-curve == host RFC-9380 oracle; "
                        "registry root == host-spec root (tested suite)",
     }
     print(json.dumps(out))

@@ -101,6 +101,32 @@ def _build_consts() -> tuple[np.ndarray, dict]:
     ng = C.g1_neg(C.G1_GEN)
     put("NEGG_X", LF.to_mont(ng[0]))
     put("NEGG_Y", LF.to_mont(ng[1]))
+
+    # Hash-to-curve constants (SSWU + isogeny + psi cofactor clearing).
+    from . import hash_to_curve as H2C
+
+    def put2(name: str, v):
+        put(name + "0", LF.to_mont(v[0] % F.P))
+        put(name + "1", LF.to_mont(v[1] % F.P))
+
+    put2("H2C_A", H2C.A_TWIST)
+    put2("H2C_B", H2C.B_TWIST)
+    put2("H2C_Z", H2C.Z_SSWU)
+    neg_b_over_a = F.fq2_mul(F.fq2_neg(H2C.B_TWIST), F.fq2_inv(H2C.A_TWIST))
+    put2("H2C_NEGBA", neg_b_over_a)
+    x1_exc = F.fq2_mul(H2C.B_TWIST,
+                       F.fq2_inv(F.fq2_mul(H2C.Z_SSWU, H2C.A_TWIST)))
+    put2("H2C_X1EXC", x1_exc)
+    for k in range(4):
+        put2(f"H2C_E8I{k}", H2C.E8_INV_POWS[k])
+        put2(f"H2C_T{k}", H2C.T_KS[k])
+    for tag, coeffs in (("XN", H2C._ISO3_X_NUM), ("XD", H2C._ISO3_X_DEN),
+                        ("YN", H2C._ISO3_Y_NUM), ("YD", H2C._ISO3_Y_DEN)):
+        for i, cf in enumerate(coeffs):
+            put2(f"H2C_{tag}{i}", cf)
+    put2("H2C_PSI_CX", H2C._PSI_CX)
+    put2("H2C_PSI_CY", H2C._PSI_CY)
+    put("RAW_ONE", LF.int_to_limbs(1))  # mont→canonical via mont_mul
     return np.concatenate(blocks, axis=0), index
 
 
@@ -120,6 +146,10 @@ def _bind_consts(cref, xbits_ref=None, pbits_ref=None) -> None:
                   for j in range(3)) for i in range(2))
     _KC["xbits"] = xbits_ref
     _KC["pbits"] = pbits_ref
+    # Default OFF: only the hash-to-curve kernel trace flips this (its
+    # pltpu.repeat materialization is Mosaic-only); re-binding here keeps
+    # the process-global flag from leaking into later eager/CPU drives.
+    _KC["in_mosaic"] = False
 
 
 def _const_specs():
@@ -615,6 +645,11 @@ def scalar_mul(ops, p, lo, hi, nbits: int = 64):
 
 LANE_BLOCK = 128  # Mosaic lane-concat pieces must be 128-aligned
 
+# The Miller/prepare/hash kernels' wide-concat mont_mul temporaries brush
+# against Mosaic's default 16 MB scoped-VMEM budget (v5e VMEM is far
+# larger); raise the per-kernel limit rather than contorting the code.
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+
 
 def _line_fq12(A, B, C, m):
     zero = (jnp.zeros((LIMBS, m), U32), jnp.zeros((LIMBS, m), U32))
@@ -626,9 +661,10 @@ def _fq2_mul_fq(a, s):
     return (o[0], o[1])
 
 
-def _miller_body(f, T, Qx, Qy, Q, xP, yP, bit):
+def _miller_dbl_step(f, T, xP, yP):
+    """Doubling half of a Miller iteration: f ← f²·l_{T,T}(P), T ← 2T.
+    Line: A = 3X³−2Y²Z, B = −3X²Z·xP, C = 2YZ²·yP."""
     m = xP.shape[1]
-    # Doubling line: A = 3X³−2Y²Z, B = −3X²Z·xP, C = 2YZ²·yP.
     X, Y, Z = T
     XX, YY, ZZ = fq2_mul_many([(X, X), (Y, Y), (Z, Z)])
     X3, Y2Z, X2Z, YZ2 = fq2_mul_many([(X, XX), (YY, Z), (XX, Z), (Y, ZZ)])
@@ -637,8 +673,12 @@ def _miller_body(f, T, Qx, Qy, Q, xP, yP, bit):
     C = _fq2_mul_fq(fq2_muls(YZ2, 2), yP)
     l_dbl = _line_fq12(A, B, C, m)
     T2 = point_add(_G2ops, T, T)
-    f = fq12_mul(fq12_sqr(f), l_dbl)
-    # Conditional add step: chord through (T2, Q).
+    return fq12_mul(fq12_sqr(f), l_dbl), T2
+
+
+def _miller_add_step(f, T2, Qx, Qy, Q, xP, yP):
+    """Addition half: f ← f·l_{T,Q}(P), T ← T + Q (chord through T2, Q)."""
+    m = xP.shape[1]
     X, Y, Z = T2
     r = fq2_mul_many([(Qy, Z), (Qx, Z)])
     Nn = fq2_sub(r[0], Y)
@@ -649,13 +689,16 @@ def _miller_body(f, T, Qx, Qy, Q, xP, yP, bit):
     C = _fq2_mul_fq(Dd, yP)
     l_add = _line_fq12(A, B, C, m)
     T3 = point_add(_G2ops, T2, Q)
-    take = bit == 1
-    f = fq12_select(take, fq12_mul(f, l_add), f)
-    T = point_select(_G2ops, take, T3, T2)
-    return f, T
+    return fq12_mul(f, l_add), T3
 
 
 def _miller_kernel(cref, xbits_ref, pbits_ref, g1_ref, g2_ref, out_ref):
+    """One 63-iteration fori; the add-step runs under ``lax.cond`` on the
+    static bit, so the 58 zero bits of |x| (Hamming weight 6) skip the
+    add-step's ~38% of the loop's products instead of computing and
+    discarding it.  (A fully segment-unrolled variant blew the 16 MB
+    scoped-VMEM budget — straight-line segments keep too many
+    simultaneously-live buffers; the cond body stays loop-scoped.)"""
     _bind_consts(cref, xbits_ref, pbits_ref)
     xP, yP = unpack_planes(g1_ref[:], 2)
     Qx, Qy = unpack_fq2s(g2_ref[:], 2)
@@ -666,8 +709,13 @@ def _miller_kernel(cref, xbits_ref, pbits_ref, g1_ref, g2_ref, out_ref):
 
     def body(i, carry):
         f, T = carry
+        f, T = _miller_dbl_step(f, T, xP, yP)
         bit = xbits[i + 1, 0]  # skip the implicit leading 1
-        return _miller_body(f, T, Qx, Qy, Q, xP, yP, bit)
+        return jax.lax.cond(
+            bit == 1,
+            lambda f, T: _miller_add_step(f, T, Qx, Qy, Q, xP, yP),
+            lambda f, T: (f, T),
+            f, T)
 
     f, _ = jax.lax.fori_loop(0, X_BITS_MILLER.shape[0], body, (f0, Q))
     out_ref[:] = pack_fq12(fq12_conj(f))  # x < 0
@@ -694,6 +742,7 @@ def miller_kernel_call(g1_planes, g2_planes):
         out_specs=pl.BlockSpec((12 * BLOCK_ROWS, LANE_BLOCK), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, m), jnp.uint32),
+        compiler_params=_COMPILER_PARAMS,
     )(*_const_args(), g1_planes, g2_planes)
 
 
@@ -748,7 +797,186 @@ def product_kernel_call(f_planes, mask):
                                    pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, m), jnp.uint32),
+        compiler_params=_COMPILER_PARAMS,
     )(*_const_args(), f_planes, mask)
+
+
+def _product_chunk_kernel(cref, xbits_ref, pbits_ref, f_ref, mask_ref,
+                          out_ref):
+    """One 256-lane chunk → 128 residue-class products (lane j and j+128
+    hold the same value after the fold; only [0:128] is written)."""
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    f = unpack_fq12(f_ref[:])
+    mask = mask_ref[:]
+    f = fq12_select(mask != 0, f, fq12_one_like(2 * LANE_BLOCK))
+    g = _fq12_roll(f, LANE_BLOCK)
+    f = fq12_mul(f, g)
+    half = tuple(tuple((c0[:, :LANE_BLOCK], c1[:, :LANE_BLOCK])
+                       for (c0, c1) in c6) for c6 in f)
+    out_ref[:] = pack_fq12(half)
+
+
+@jax.jit
+def product_chunks_kernel_call(f_planes, mask):
+    """Per-chunk masked lane fold: (384, C·256) Miller outputs →
+    (384, C·128) residue-class products, one grid cell per chunk.  The
+    concatenated output feeds :func:`finalize_kernel_call` directly."""
+    m = f_planes.shape[1]
+    if m % (2 * LANE_BLOCK):
+        raise ValueError("lane count must be C · 256")
+    C = m // (2 * LANE_BLOCK)
+    return pl.pallas_call(
+        _product_chunk_kernel,
+        grid=(C,),
+        in_specs=_const_specs() + [
+            pl.BlockSpec((12 * BLOCK_ROWS, 2 * LANE_BLOCK), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2 * LANE_BLOCK), lambda c: (0, c),
+                         memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((12 * BLOCK_ROWS, LANE_BLOCK), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, C * LANE_BLOCK),
+                                       jnp.uint32),
+        compiler_params=_COMPILER_PARAMS,
+    )(*_const_args(), f_planes, mask)
+
+
+# ---------------------------------------------------------------------------
+# Finalize kernel: full lane fold + in-kernel final exponentiation
+# ---------------------------------------------------------------------------
+
+
+def k_fq12_pow_x_abs(f):
+    """f^|x| (BLS parameter ladder), 64 static bits from SMEM."""
+    m = f[0][0][0].shape[1]
+    one = fq12_one_like(m)
+    xbits = _KC["xbits"]
+
+    def body(i, acc):
+        acc = fq12_sqr(acc)
+        take = xbits[i, 0] == 1
+        return fq12_select(take, fq12_mul(acc, f), acc)
+
+    return jax.lax.fori_loop(0, X_BITS_FULL.shape[0], body, one)
+
+
+def k_pow_u(f):
+    """f^u for the (negative) BLS parameter — cyclotomic f only."""
+    return fq12_conj(k_fq12_pow_x_abs(f))
+
+
+def k_final_exp_easy(f):
+    """Easy part f^((q⁶−1)(q²+1)) — needs the true Fq12 inverse."""
+    m = fq12_mul(fq12_conj(f), fq12_inv(f))
+    return fq12_mul(fq12_frobenius(m, 2), m)
+
+
+def k_final_exp_hard(m):
+    """HHT hard part ×3: m^(3·(p⁴−p²+1)/r) for cyclotomic m."""
+    m1 = fq12_mul(k_pow_u(m), fq12_conj(m))              # m^(u−1)
+    k2 = fq12_mul(k_pow_u(m1), fq12_conj(m1))            # ^(u−1)
+    k3 = fq12_mul(k_pow_u(k2), fq12_frobenius(k2, 1))    # ^(u+p)
+    k4 = fq12_mul(fq12_mul(k_pow_u(k_pow_u(k3)), fq12_frobenius(k3, 2)),
+                  fq12_conj(k3))                         # ^(u²+p²−1)
+    return fq12_mul(k4, fq12_mul(fq12_sqr(m), m))
+
+
+def k_final_exp_cubed(f):
+    """f^(3·(q¹²−1)/r) — same HHT decomposition as the host oracle
+    (:func:`..pairing.final_exponentiation_cubed`) and the XLA twin
+    (:func:`..limb_pairing.final_exponentiation_cubed`)."""
+    return k_final_exp_hard(k_final_exp_easy(f))
+
+
+def _roll_lanes(x, w: int):
+    """Rotate lanes left by w.  Aligned concat when both pieces are
+    128-multiples; ``pltpu.roll`` for sub-128 shifts."""
+    m = x.shape[1]
+    if w % LANE_BLOCK == 0 and (m - w) % LANE_BLOCK == 0:
+        return jnp.concatenate([x[:, w:], x[:, :w]], axis=1)
+    return pltpu.roll(x, m - w, 1)
+
+
+def _fq12_roll(f, w: int):
+    return tuple(tuple((_roll_lanes(c0, w), _roll_lanes(c1, w))
+                       for (c0, c1) in c6) for c6 in f)
+
+
+def _finalize_easy_kernel(cref, xbits_ref, pbits_ref, f_ref, out_ref):
+    """(384, 128) residue-class products (dead lanes already 1) → full
+    lane fold + the EASY part of the final exponentiation
+    (f^((q⁶−1)(q²+1)), which needs the true Fq12 inverse).  Split from
+    the hard part so each program stays within the scoped-VMEM budget."""
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    f = unpack_fq12(f_ref[:])
+    w = f[0][0][0].shape[1] // 2
+    while w >= 1:
+        f = fq12_mul(f, _fq12_roll(f, w))
+        w //= 2
+    out_ref[:] = pack_fq12(k_final_exp_easy(f))
+
+
+def _finalize_hard_kernel(cref, xbits_ref, pbits_ref, m_ref, out_ref):
+    """Easy-part output → HHT hard part ×3 → ``∏ == 1`` int32 flag."""
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    m = unpack_fq12(m_ref[:])
+    g = k_final_exp_hard(m)
+    ok = fq12_is_one(g).astype(jnp.int32)  # (1, 128); all lanes equal
+    out_ref[0, 0] = ok[0, 0]
+
+
+def blocks_to_limb_fq12(f_planes):
+    """(384, M) kernel block layout → (M, 2, 3, 2, 26) XLA-twin limb
+    layout (the :mod:`..limb_pairing` convention)."""
+    m = f_planes.shape[1]
+    comps = f_planes.reshape(12, BLOCK_ROWS, m)[:, :LIMBS, :]  # (12, 26, M)
+    comps = jnp.transpose(comps, (2, 0, 1))                    # (M, 12, 26)
+    return comps.reshape(m, 2, 3, 2, LIMBS)
+
+
+@jax.jit
+def finalize_xla_tail(f_planes):
+    """(384, 128) → verdict via the scanned XLA twin
+    (:mod:`..limb_pairing`) — the Mosaic-free fallback finalize tail."""
+    f = blocks_to_limb_fq12(f_planes)               # (128, 2, 3, 2, 26)
+    prod = XP._product_reduce(f)
+    ok = XP.fq12_is_one(XP.final_exponentiation_cubed(prod))
+    return ok.astype(jnp.int32).reshape(1, 1)
+
+
+@jax.jit
+def finalize_kernel_call(f_planes):
+    """Fold an entire batch's (384, M) lane products (M a power of two,
+    ≥ 128) into one Fq12, run the shared final exponentiation on-device,
+    and return a (1, 1) int32 ``is_one`` flag — the only bytes the host
+    ever pulls back for a verify call.
+
+    Widths above 128 are halved with the gridded 256→128 Pallas product
+    cells (bounded VMEM per cell); the 128→1 fold + easy part and the
+    HHT hard part run as two Pallas programs (split so each fits the
+    scoped-VMEM budget, raised via ``_COMPILER_PARAMS``).
+    :func:`finalize_xla_tail` is the scanned-XLA fallback."""
+    m = f_planes.shape[1]
+    if m < LANE_BLOCK or m & (m - 1):
+        raise ValueError("lane count must be a power of two ≥ 128")
+    while f_planes.shape[1] > LANE_BLOCK:
+        ones = jnp.ones((1, f_planes.shape[1]), jnp.int32)
+        f_planes = product_chunks_kernel_call(f_planes, ones)
+    easy = pl.pallas_call(
+        _finalize_easy_kernel,
+        in_specs=_const_specs() + [pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, LANE_BLOCK),
+                                       jnp.uint32),
+        compiler_params=_COMPILER_PARAMS,
+    )(*_const_args(), f_planes)
+    return pl.pallas_call(
+        _finalize_hard_kernel,
+        in_specs=_const_specs() + [pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        compiler_params=_COMPILER_PARAMS,
+    )(*_const_args(), easy)
 
 
 # ---------------------------------------------------------------------------
@@ -766,15 +994,15 @@ def _prepare_kernel(cref, xbits_ref, pbits_ref, pk_ref, kmask_ref, lo_ref,
 
     def body(k, acc):
         off = k * S
-        cols = unpack_planes(pk_ref[:, pl.ds(off, S)], 3)
+        x, y = unpack_planes(pk_ref[:, pl.ds(off, S)], 2)
         live = kmask_ref[:, pl.ds(off, S)] != 0
-        blk = point_select(_G1ops, live, tuple(cols),
+        blk = point_select(_G1ops, live, (x, y, _G1ops.one_like(S)),
                            point_identity(_G1ops, S))
         return point_add(_G1ops, acc, blk)
 
     acc = jax.lax.fori_loop(0, K, body, acc)
     # Live sets with identity aggregates are invalid (blst/PythonBackend
-    # rule); report per-lane so the host can also mask those lanes.
+    # rule); reported per-lane and folded into the batch verdict.
     flags_ref[:] = (k_is_zero(acc[2])).astype(jnp.int32)
     # Lanes [0:S] = c_i · aggpk_i; lanes [S:2S] = −c_i · G.
     negg = (jnp.broadcast_to(_KC["NEGG_X"], (LIMBS, S)),
@@ -793,23 +1021,38 @@ def _prepare_kernel(cref, xbits_ref, pbits_ref, pk_ref, kmask_ref, lo_ref,
 
 @partial(jax.jit, static_argnames=("K",))
 def prepare_kernel_call(pk_planes, kmask, lo, hi, *, K: int):
-    """pk (96, K·128) K-major blocks of projective G1 pubkeys; kmask
-    (1, K·128) int32; lo/hi (1, 128) uint32 RLC scalar words.
+    """pk (64, C·K·128) K-major blocks of AFFINE G1 pubkeys per chunk
+    (chunk c's key k of set s at column c·K·128 + k·128 + s); kmask
+    (1, C·K·128) int32; lo/hi (1, C·128) uint32 RLC scalar words.  The
+    grid runs one cell per 128-set chunk.
 
-    Returns (g1_aff (64, 256) blocks, ident_flags (1, 128) int32): lanes [0:128]
-    are the affine c_i·aggpk_i (pair them with H(m_i)), lanes [128:256] the
-    affine −c_i·G (pair them with σ_i) — the signature side of the RLC is
-    carried by the pairing bilinearity instead of a G2 ladder:
+    Returns (g1_aff (64, C·256) blocks, ident_flags (1, C·128) int32):
+    per chunk, lanes [0:128] are the affine c_i·aggpk_i (pair them with
+    H(m_i)), lanes [128:256] the affine −c_i·G (pair them with σ_i) — the
+    signature side of the RLC is carried by the pairing bilinearity
+    instead of a G2 ladder:
     ∏ e(c_i·pk_i, H_i) · ∏ e(−c_i·G, σ_i) == 1.
     """
     S = PREP_S
-    if pk_planes.shape[1] != K * S:
-        raise ValueError("pk lanes must be K · 128")
+    if pk_planes.shape[1] % (K * S):
+        raise ValueError("pk lanes must be C · K · 128")
+    C = pk_planes.shape[1] // (K * S)
     return pl.pallas_call(
         partial(_prepare_kernel, K=K),
-        in_specs=_const_specs() + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
-        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)),
-        out_shape=(jax.ShapeDtypeStruct((2 * BLOCK_ROWS, 2 * S), jnp.uint32),
-                   jax.ShapeDtypeStruct((1, S), jnp.int32)),
+        grid=(C,),
+        in_specs=_const_specs() + [
+            pl.BlockSpec((2 * BLOCK_ROWS, K * S), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, K * S), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S), lambda c: (0, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S), lambda c: (0, c), memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((2 * BLOCK_ROWS, 2 * S), lambda c: (0, c),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((1, S), lambda c: (0, c),
+                                memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((2 * BLOCK_ROWS, 2 * S * C),
+                                        jnp.uint32),
+                   jax.ShapeDtypeStruct((1, S * C), jnp.int32)),
+        compiler_params=_COMPILER_PARAMS,
     )(*_const_args(), pk_planes, kmask, lo, hi)

@@ -28,7 +28,7 @@ the jit cache.
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 from typing import Sequence
 
 import numpy as np
@@ -137,69 +137,180 @@ def _g2_aff_col(point) -> bytes:
     return col.tobytes()
 
 
-def _h_aff_col(message: bytes) -> bytes:
-    return _g2_aff_col(_h_point(message))
+class _DevicePubkeyTable:
+    """HBM-resident decompressed pubkey columns — the device half of the
+    reference's ``ValidatorPubkeyCache`` (``validator_pubkey_cache.rs:18``):
+    each distinct pubkey is marshalled to its (64,) affine limb column
+    exactly once; verify calls ship uint32 indices and the device gathers.
+
+    New columns append with a device-side ``.at[].set`` (a 256-byte h2d +
+    on-device copy — never a full-table re-upload); capacity doubling pads
+    on-device.  Bounded by ``max_keys`` (≈ a registry's worth): beyond it
+    the table resets rather than growing without bound under adversarial
+    never-seen keys."""
+
+    def __init__(self, initial: int = 1 << 15, max_keys: int = 1 << 21):
+        self._initial = initial
+        self._max_keys = max_keys
+        self._reset()
+
+    def _reset(self) -> None:
+        self._index: dict = {}
+        self._host = np.zeros((64, self._initial), np.uint32)
+        self._n = 1  # column 0 stays zero for masked slots
+        self._device = None
+
+    def maybe_reset(self) -> None:
+        """Call BETWEEN batches only: resetting mid-marshal would
+        invalidate indices already recorded for the in-flight batch."""
+        if self._n >= self._max_keys:
+            self._reset()
+
+    def index_of(self, point) -> int:
+        i = self._index.get(point)
+        if i is None:
+            if self._n == self._host.shape[1]:
+                self._host = np.concatenate(
+                    [self._host, np.zeros_like(self._host)], axis=1)
+                if self._device is not None:
+                    self._device = jnp.pad(
+                        self._device,
+                        ((0, 0), (0, self._device.shape[1])))
+            col = np.frombuffer(_g1_aff_col(point), np.uint32)
+            self._host[:, self._n] = col
+            if self._device is not None:
+                self._device = self._device.at[:, self._n].set(
+                    jnp.asarray(col))
+            i = self._index[point] = self._n
+            self._n += 1
+        return i
+
+    def device(self):
+        if self._device is None:
+            self._device = jnp.asarray(self._host)
+        return self._device
 
 
-def _lane_fq12(planes: np.ndarray, lane: int):
-    """(384, M) device blocks → host Fq12 tuple for one lane."""
-    c = [LF.from_mont(planes[i * 32:i * 32 + 26, lane]) for i in range(12)]
-    return (((c[0], c[1]), (c[2], c[3]), (c[4], c[5])),
-            ((c[6], c[7]), (c[8], c[9]), (c[10], c[11])))
+_PK_TABLE = _DevicePubkeyTable()
+
+
+@partial(jax.jit, static_argnames=("K",))
+def _fused_pipeline(table, idx, kmask, lo, hi, u_planes, sig_cols,
+                    lane_mask, setlive, *, K: int):
+    """Batch verify up to the 128-class lane products, as one device
+    program per (C, K, capacity) shape bucket: pubkey gather →
+    hash-to-curve of every message → prepare (G1 aggregation + RLC
+    ladders) → batched Miller loops → per-chunk lane folds → (384, 128)
+    residue products + bad-aggregate flag."""
+    from . import pairing_kernel as PK
+    from . import htc_kernel as HK
+
+    S = PK.PREP_S
+    C = sig_cols.shape[1] // S
+    pk = jnp.take(table, idx, axis=1)                   # (64, C·K·S)
+    g1_aff, flags = PK.prepare_kernel_call(pk, kmask, lo, hi, K=K)
+    h_cols = HK.hash_g2_kernel_call(u_planes)           # (128, C·S)
+    g2 = jnp.stack([h_cols.reshape(128, C, S),
+                    sig_cols.reshape(128, C, S)],
+                   axis=2).reshape(128, C * 2 * S)
+    f = PK.miller_kernel_call(g1_aff, g2)
+    prod = PK.product_chunks_kernel_call(f, lane_mask)
+    while prod.shape[1] > PK.LANE_BLOCK:
+        ones = jnp.ones((1, prod.shape[1]), jnp.int32)
+        prod = PK.product_chunks_kernel_call(prod, ones)
+    bad = jnp.any((flags != 0) & (setlive != 0))
+    return prod, bad
+
+
+@jax.jit
+def _combine_verdict(ok, bads):
+    return (ok[0, 0] != 0) & ~jnp.any(bads)
+
+
+def _fq12_one_block() -> np.ndarray:
+    """(384, 128) kernel-block-layout Fq12 ONE — pads the cross-group
+    product concat to a power of two (acts as a masked-out lane)."""
+    out = np.zeros((384, 128), np.uint32)
+    out[0:26, :] = np.asarray(LF.ONE_MONT)[:, None]
+    return out
+
+
+_ONE_BLOCK = _fq12_one_block()
+
+
+def _marshal_group(entries, rand_fn):
+    """One K-bucket's host marshalling: pubkey-table indices, RLC scalar
+    words, u-values, signature columns, masks."""
+    from . import pairing_kernel as PK
+    from . import htc_kernel as HK
+
+    S = PK.PREP_S
+    n = len(entries)
+    C = _next_pow2((n + S - 1) // S)
+    K = _next_pow2(max(len(e[1]) for e in entries))
+    idx = np.zeros(C * K * S, np.int32)
+    kmask = np.zeros((1, C * K * S), np.int32)
+    lo = np.zeros((1, C * S), np.uint32)
+    hi = np.zeros((1, C * S), np.uint32)
+    sig_cols = np.zeros((128, C * S), np.uint32)
+    lane_mask = np.zeros((1, C * 2 * S), np.int32)
+    messages = []
+    for s0, (sig_pt, keys, msg) in enumerate(entries):
+        c, s = divmod(s0, S)
+        kbase = c * K * S
+        for k, kp in enumerate(keys):
+            idx[kbase + k * S + s] = _PK_TABLE.index_of(kp)
+        kmask[0, kbase + S * np.arange(len(keys)) + s] = 1
+        rand = rand_fn()
+        lo[0, c * S + s] = rand & 0xFFFFFFFF
+        hi[0, c * S + s] = rand >> 32
+        messages.append((c, s, bytes(msg)))
+        lane_mask[0, c * 2 * S + s] = 1
+        if sig_pt is not None:
+            sig_cols[:, c * S + s] = np.frombuffer(_g2_aff_col(sig_pt),
+                                                   np.uint32)
+            lane_mask[0, c * 2 * S + S + s] = 1
+    u_planes = HK.u_planes_for_messages(messages, C)
+    setlive = lane_mask.reshape(C, 2, S)[:, 0, :].reshape(1, C * S)
+    return (jnp.asarray(idx), jnp.asarray(kmask), jnp.asarray(lo),
+            jnp.asarray(hi), jnp.asarray(u_planes), jnp.asarray(sig_cols),
+            jnp.asarray(lane_mask),
+            jnp.asarray(np.ascontiguousarray(setlive)), K)
 
 
 def _dispatch_pallas(entries, rand_fn) -> bool:
-    """Chunked device pipeline replicating ``_verify_sets_kernel`` semantics:
+    """Marshal a batch and run the fused device pipeline:
 
         ∏ e(c_i·aggpk_i, H(m_i)) · ∏ e(−c_i·G, σ_i) == 1
 
     (the signature side of the RLC rides the pairing bilinearity — no G2
-    ladder).  Each 128-set chunk runs the prepare kernel + one 256-lane
-    Miller launch; lane products land on the host for ONE shared
-    final exponentiation across the whole call.
-    """
+    ladder).  Sets group by K = next-pow2(signer count) so one 512-key
+    sync-committee set doesn't pad a thousand single-key sets to K=512;
+    each group runs its own pipeline dispatch, every group's (384, 128)
+    residue products concat into ONE shared finalize (fold + final
+    exponentiation — its ~13-minute XLA compile happens once across all
+    buckets, not per (C, K)), and the host pulls back a single bool.
+    Message hashing is host SHA-256 (expand_message_xmd) + the device
+    SSWU kernel — no host curve math at all."""
     from . import pairing_kernel as PK
-    from .pairing import final_exponentiation_cubed
-    from . import fields as F
 
-    S = PK.PREP_S
-    acc = F.FQ12_ONE
-    for base in range(0, len(entries), S):
-        chunk = entries[base:base + S]
-        n = len(chunk)
-        K = _next_pow2(max(len(e[1]) for e in chunk))
-        pk = np.zeros((96, K * S), np.uint32)
-        kmask = np.zeros((1, K * S), np.int32)
-        lo = np.zeros((1, S), np.uint32)
-        hi = np.zeros((1, S), np.uint32)
-        g2 = np.zeros((128, 2 * S), np.uint32)
-        lane_mask = np.zeros((1, 2 * S), np.int32)
-        one_col = np.zeros(26, np.uint32)
-        one_col[:] = np.asarray(LF.ONE_MONT)
-        for s, (sig_pt, keys, msg) in enumerate(chunk):
-            for k, kp in enumerate(keys):
-                col = k * S + s
-                pk[0:64, col] = np.frombuffer(_g1_aff_col(kp), np.uint32)
-                pk[64:90, col] = one_col  # projective Z = 1
-                kmask[0, col] = 1
-            c = rand_fn()
-            lo[0, s] = c & 0xFFFFFFFF
-            hi[0, s] = c >> 32
-            g2[:, s] = np.frombuffer(_h_aff_col(bytes(msg)), np.uint32)
-            lane_mask[0, s] = 1
-            if sig_pt is not None:
-                g2[:, S + s] = np.frombuffer(_g2_aff_col(sig_pt), np.uint32)
-                lane_mask[0, S + s] = 1
-        g1_aff, idflags = PK.prepare_kernel_call(
-            jnp.asarray(pk), jnp.asarray(kmask), jnp.asarray(lo),
-            jnp.asarray(hi), K=K)
-        if bool(np.asarray(idflags)[0, :n].any()):
-            return False  # a live set's aggregate pubkey is the identity
-        f = PK.miller_kernel_call(g1_aff, jnp.asarray(g2))
-        prod = np.asarray(PK.product_kernel_call(f, jnp.asarray(lane_mask)))
-        for lane in range(S):
-            acc = F.fq12_mul(acc, _lane_fq12(prod, lane))
-    return final_exponentiation_cubed(acc) == F.FQ12_ONE
+    _PK_TABLE.maybe_reset()
+    groups: dict = {}
+    for e in entries:
+        groups.setdefault(_next_pow2(max(1, len(e[1]))), []).append(e)
+    args = [_marshal_group(groups[k], rand_fn) for k in sorted(groups)]
+    table = _PK_TABLE.device()  # after marshalling registered new keys
+    prods, bads = [], []
+    for (idx, kmask, lo, hi, u, sig, lm, setlive, K) in args:
+        prod, bad = _fused_pipeline(table, idx, kmask, lo, hi, u, sig,
+                                    lm, setlive, K=K)
+        prods.append(prod)
+        bads.append(bad)
+    g = _next_pow2(len(prods))
+    prods += [jnp.asarray(_ONE_BLOCK)] * (g - len(prods))
+    prod = prods[0] if g == 1 else jnp.concatenate(prods, axis=1)
+    ok = PK.finalize_kernel_call(prod)
+    return bool(_combine_verdict(ok, jnp.stack(bads)))
 
 
 def _dispatch(entries, rand_fn) -> bool:

@@ -1,0 +1,84 @@
+"""Per-stage profile of the fused TPU BLS batch-verify pipeline.
+
+The production path is ONE jit (`tpu_backend._fused_verify`) with a single
+host sync, so end-to-end stage costs can't be timed from outside; this
+script times (a) the host marshalling pieces, (b) each kernel queued N×
+with one sync (true device cost, amortizing the ~100 ms axon tunnel
+roundtrip), and (c) the fused call end-to-end.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+from __graft_entry__ import _enable_compile_cache
+_enable_compile_cache()
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto import tpu_backend as TB
+from lighthouse_tpu.crypto import pairing_kernel as PK
+from lighthouse_tpu.crypto import htc_kernel as HK
+
+N_SETS = 256
+S = PK.PREP_S
+sks = [bls.SecretKey(0x1000 + i) for i in range(8)]
+pks = [k.public_key() for k in sks]
+msgs = [b"bench-msg-%02d" % i for i in range(64)]
+sets = [bls.SignatureSet(sks[i % 8].sign(msgs[i % 64]), [pks[i % 8]],
+                         msgs[i % 64]) for i in range(N_SETS)]
+
+tpu = bls._BACKENDS["tpu"]
+assert tpu.verify_signature_sets(sets)  # warm every kernel + the table
+
+# --- host marshalling cost --------------------------------------------------
+entries = [(s.signature.point, [k.point for k in s.signing_keys],
+            bytes(s.message)) for s in sets]
+t0 = time.perf_counter()
+messages = [(i // S, i % S, e[2]) for i, e in enumerate(entries)]
+u = HK.u_planes_for_messages(messages, 2)
+print(f"u_planes (64 msgs × reuse): {(time.perf_counter()-t0)*1e3:8.2f} ms")
+
+# --- per-kernel device cost: queue N, sync once -----------------------------
+rng = np.random.default_rng(0)
+N = 10
+C = 2
+pk = jnp.asarray(rng.integers(0, 2**16, (64, C * S)).astype(np.uint32))
+kmask = jnp.ones((1, C * S), jnp.int32)
+lo = jnp.ones((1, C * S), jnp.uint32)
+hi = jnp.zeros((1, C * S), jnp.uint32)
+g2 = jnp.asarray(rng.integers(0, 2**16, (128, C * 2 * S)).astype(np.uint32))
+lm = jnp.ones((1, C * 2 * S), jnp.int32)
+ud = jnp.asarray(u)
+
+g1_aff, fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
+f = PK.miller_kernel_call(g1_aff, g2)
+prod = PK.product_chunks_kernel_call(f, lm)
+ok = PK.finalize_kernel_call(prod)
+h = HK.hash_g2_kernel_call(ud)
+jax.block_until_ready((ok, h))
+
+for name, fn in [
+    ("hash_g2 (256 msgs)", lambda: HK.hash_g2_kernel_call(ud)),
+    ("prepare (C=2,K=1)", lambda: PK.prepare_kernel_call(
+        pk, kmask, lo, hi, K=1)[0]),
+    ("miller (512 lanes)", lambda: PK.miller_kernel_call(g1_aff, g2)),
+    ("product (C=2)", lambda: PK.product_chunks_kernel_call(f, lm)),
+    ("finalize (256→1)", lambda: PK.finalize_kernel_call(prod)),
+]:
+    t0 = time.perf_counter()
+    outs = [fn() for _ in range(N)]
+    jax.block_until_ready(outs)
+    print(f"{name:22s} {(time.perf_counter()-t0)*1e3/N:8.2f} ms/call")
+
+# --- end-to-end fused verify ------------------------------------------------
+for _ in range(3):
+    t0 = time.perf_counter()
+    assert tpu.verify_signature_sets(sets)
+    dt = time.perf_counter() - t0
+    print(f"fused verify {N_SETS} sets: {dt*1e3:8.1f} ms "
+          f"({N_SETS/dt:6.0f} sets/s)")

@@ -1,0 +1,70 @@
+"""Measure axon-tunnel roundtrip latency vs true device kernel cost."""
+import os
+import sys
+import time
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+from __graft_entry__ import _enable_compile_cache
+_enable_compile_cache()
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto import pairing_kernel as PK
+
+# --- pure roundtrip: tiny compute, full sync --------------------------------
+x = jnp.zeros(8, jnp.uint32)
+np.asarray(x + 1)
+for _ in range(3):
+    t0 = time.perf_counter()
+    np.asarray(x + 1)
+    print(f"tiny roundtrip: {(time.perf_counter() - t0) * 1e3:8.2f} ms")
+
+# --- transfer bandwidth -----------------------------------------------------
+big = np.zeros((96, 128), np.uint32)
+for n in (1, 10):
+    t0 = time.perf_counter()
+    ds = [jnp.asarray(big) for _ in range(n)]
+    jax.block_until_ready(ds)
+    print(f"h2d {n}x 49KB: {(time.perf_counter() - t0) * 1e3:8.2f} ms")
+
+# --- per-kernel device cost: queue N, sync once -----------------------------
+S = PK.PREP_S
+rng = np.random.default_rng(0)
+pk = jnp.asarray(rng.integers(0, 2**16, (96, S), np.uint32).astype(np.uint32))
+kmask = jnp.ones((1, S), jnp.int32)
+lo = jnp.ones((1, S), jnp.uint32)
+hi = jnp.zeros((1, S), jnp.uint32)
+g2 = jnp.asarray(rng.integers(0, 2**16, (128, 2 * S)).astype(np.uint32))
+lm = jnp.ones((1, 2 * S), jnp.int32)
+
+g1_aff, fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
+f = PK.miller_kernel_call(g1_aff, g2)
+prod = PK.product_kernel_call(f, lm)
+jax.block_until_ready(prod)
+
+N = 10
+t0 = time.perf_counter()
+outs = [PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)[0] for _ in range(N)]
+jax.block_until_ready(outs)
+print(f"prepare x{N}: {(time.perf_counter() - t0) * 1e3 / N:8.2f} ms/call")
+
+t0 = time.perf_counter()
+outs = [PK.miller_kernel_call(g1_aff, g2) for _ in range(N)]
+jax.block_until_ready(outs)
+print(f"miller(256) x{N}: {(time.perf_counter() - t0) * 1e3 / N:8.2f} ms/call")
+
+t0 = time.perf_counter()
+outs = [PK.product_kernel_call(f, lm) for _ in range(N)]
+jax.block_until_ready(outs)
+print(f"product x{N}: {(time.perf_counter() - t0) * 1e3 / N:8.2f} ms/call")
+
+# --- chained without sync: full pipeline queued then one sync ---------------
+t0 = time.perf_counter()
+for _ in range(N):
+    a, _fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
+    ff = PK.miller_kernel_call(a, g2)
+    pr = PK.product_kernel_call(ff, lm)
+jax.block_until_ready(pr)
+print(f"chain x{N}: {(time.perf_counter() - t0) * 1e3 / N:8.2f} ms/chunk")

@@ -1,0 +1,136 @@
+"""Hash-to-curve + finalize kernel arithmetic on CPU — no TPU required.
+
+Same strategy as ``test_pairing_kernel_cpu.py``: bind the packed constant
+planes and drive the EXACT in-kernel helpers eagerly against the host
+oracles.  The ladder-heavy pieces (the 758-bit SSWU sqrt, the psi cofactor
+ladders, the full final exponentiation) compile for minutes on CPU XLA, so
+they run only when ``RUN_SLOW_KERNEL_TESTS=1`` (CI fast path covers the
+ladder-free algebra; the on-chip path is validated by
+``tests/test_pairing_kernel.py`` / ``bench.py`` on the real device).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto import fields as F
+from lighthouse_tpu.crypto import limb_field as LF
+from lighthouse_tpu.crypto import hash_to_curve as H
+from lighthouse_tpu.crypto import pairing_kernel as PK
+from lighthouse_tpu.crypto import htc_kernel as HK
+
+random.seed(0xBEEF)
+
+SLOW = os.environ.get("RUN_SLOW_KERNEL_TESTS") != "1"
+slow = pytest.mark.skipif(
+    SLOW, reason="ladder kernels cost minutes of CPU XLA compile; "
+                 "set RUN_SLOW_KERNEL_TESTS=1 (on-chip path covers them)")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def bind_consts():
+    PK._bind_consts(
+        jnp.asarray(PK.CONSTS_PLANES),
+        jnp.asarray(PK.X_BITS_FULL.reshape(-1, 1).astype(np.int32)),
+        jnp.asarray(PK.P_MINUS_2_BITS.reshape(-1, 1).astype(np.int32)))
+    PK._KC["e16"] = jnp.asarray(HK.E16_BITS_LSB.reshape(-1, 1))
+    PK._KC["in_mosaic"] = False  # eager drive: no pltpu.repeat lowering
+    yield
+
+
+def _fq2_plane(vals):
+    return (jnp.asarray(np.stack([LF.to_mont(v[0] % F.P) for v in vals], 1)),
+            jnp.asarray(np.stack([LF.to_mont(v[1] % F.P) for v in vals], 1)))
+
+
+def _fq2_from(pl):
+    a = np.asarray(pl[0])
+    b = np.asarray(pl[1])
+    return [(LF.from_mont(a[:, i]), LF.from_mont(b[:, i]))
+            for i in range(a.shape[1])]
+
+
+def _rand_fq2():
+    return (random.randrange(F.P), random.randrange(F.P))
+
+
+def test_k_sgn0_matches_host():
+    vals = [(0, 0), (0, 1), (1, 0), (2, 5), _rand_fq2(), _rand_fq2()]
+    got = np.asarray(HK.k_sgn0_fq2(_fq2_plane(vals)))[0]
+    want = [F.fq2_sgn0(v) for v in vals]
+    assert list(got) == want
+
+
+def test_k_iso_map_matches_host():
+    ts = [_rand_fq2() for _ in range(3)]
+    pts = [H.map_to_curve_sswu(t) for t in ts]
+    x = _fq2_plane([p[0] for p in pts])
+    y = _fq2_plane([p[1] for p in pts])
+    q = HK.k_iso_map_proj(x, y)
+    Xs, Ys, Zs = _fq2_from(q[0]), _fq2_from(q[1]), _fq2_from(q[2])
+    for i, p in enumerate(pts):
+        want = H.iso_map(p)
+        zi = F.fq2_inv(Zs[i])
+        assert (F.fq2_mul(Xs[i], zi), F.fq2_mul(Ys[i], zi)) == want
+
+
+def test_k_psi_matches_host():
+    pts = [H.iso_map(H.map_to_curve_sswu(_rand_fq2())) for _ in range(3)]
+    proj = (_fq2_plane([p[0] for p in pts]), _fq2_plane([p[1] for p in pts]),
+            _fq2_plane([F.FQ2_ONE] * 3))
+    out = HK.k_psi(proj)
+    Xs, Ys, Zs = _fq2_from(out[0]), _fq2_from(out[1]), _fq2_from(out[2])
+    for i, p in enumerate(pts):
+        want = H.psi(p)
+        zi = F.fq2_inv(Zs[i])
+        assert (F.fq2_mul(Xs[i], zi), F.fq2_mul(Ys[i], zi)) == want
+
+
+@slow
+def test_k_sswu_map_matches_host():
+    ts = [_rand_fq2() for _ in range(2)] + [(0, 0)]
+    x, y = HK.k_sswu_map(_fq2_plane(ts))
+    got = list(zip(_fq2_from(x), _fq2_from(y)))
+    for i, t in enumerate(ts):
+        assert got[i] == H.map_to_curve_sswu(t), f"lane {i}"
+
+
+@slow
+def test_k_clear_cofactor_matches_host():
+    pts = [H.iso_map(H.map_to_curve_sswu(_rand_fq2())) for _ in range(2)]
+    proj = (_fq2_plane([p[0] for p in pts]), _fq2_plane([p[1] for p in pts]),
+            _fq2_plane([F.FQ2_ONE] * 2))
+    out = HK.k_clear_cofactor(proj)
+    Xs, Ys, Zs = _fq2_from(out[0]), _fq2_from(out[1]), _fq2_from(out[2])
+    for i, p in enumerate(pts):
+        want = H.clear_cofactor(p)
+        zi = F.fq2_inv(Zs[i])
+        assert (F.fq2_mul(Xs[i], zi), F.fq2_mul(Ys[i], zi)) == want
+
+
+@slow
+def test_k_final_exp_cubed_matches_host():
+    from lighthouse_tpu.crypto.pairing import final_exponentiation_cubed
+
+    def _fq12_plane(vals):
+        return tuple(
+            tuple(_fq2_plane([v[i][j] for v in vals]) for j in range(3))
+            for i in range(2))
+
+    def _fq12_from(p):
+        out = []
+        n = np.asarray(p[0][0][0]).shape[1]
+        cs = [[_fq2_from(p[i][j]) for j in range(3)] for i in range(2)]
+        for m in range(n):
+            out.append(tuple(tuple(cs[i][j][m] for j in range(3))
+                             for i in range(2)))
+        return out
+
+    vals = [tuple(tuple(_rand_fq2() for _ in range(3)) for _ in range(2))
+            for _ in range(2)]
+    got = _fq12_from(PK.k_final_exp_cubed(_fq12_plane(vals)))
+    for g, v in zip(got, vals):
+        assert g == final_exponentiation_cubed(v)

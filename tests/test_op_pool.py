@@ -157,3 +157,41 @@ def test_get_attestations_filters_mismatched_source():
         for stored in entry:
             stored.data.source.root = b"\xee" * 32
     assert pool.get_attestations(h.state, h.T) == []
+
+
+def test_columnar_packing_matches_dict_path():
+    """The columnar numpy max-cover (large-pool fast path) must choose the
+    same attestations as the dict-based greedy."""
+    from lighthouse_tpu.op_pool import (
+        AttMaxCover, _pack_columnar, maximum_cover, _StoredAttestation)
+
+    rng = np.random.default_rng(3)
+    n_validators = 4096
+    balances = rng.integers(1, 32 * 10**9, n_validators).astype(np.uint64)
+    seen_cur = rng.random(n_validators) < 0.3
+    seen_prev = rng.random(n_validators) < 0.3
+    candidates = []
+    for i in range(300):
+        committee = rng.choice(n_validators, 64, replace=False)
+        bits = rng.random(64) < 0.5
+        stored = _StoredAttestation(data=None, bits=bits,
+                                    signature=b"", committee=committee)
+        candidates.append((stored, bool(i % 2)))
+
+    covers = []
+    for stored, is_cur in candidates:
+        seen = seen_cur if is_cur else seen_prev
+        idx = np.asarray(stored.committee[stored.bits], dtype=np.int64)
+        fresh = idx[~seen[idx]]
+        if fresh.size:
+            covers.append(AttMaxCover(stored, fresh, balances))
+    want = [c.att for c in maximum_cover(covers, 128)]
+    got = _pack_columnar(candidates, balances, seen_cur, seen_prev, 128)
+    assert [id(s) for s in got] == [id(s) for s in want]
+
+
+def test_bench_pack_attestations_smoke():
+    from lighthouse_tpu.op_pool import bench_pack_attestations
+
+    ms, packed = bench_pack_attestations(3000, n_validators=1 << 14)
+    assert packed > 0
