@@ -240,7 +240,9 @@ _ONE_BLOCK = _fq12_one_block()
 
 def _marshal_group(entries, rand_fn):
     """One K-bucket's host marshalling: pubkey-table indices, RLC scalar
-    words, u-values, signature columns, masks."""
+    words, u-values, signature columns, masks.  Column placement is
+    vectorized — the only per-entry Python work left is the pubkey-table
+    dict lookups, the memoised u-column lookups, and ``rand_fn``."""
     from . import pairing_kernel as PK
     from . import htc_kernel as HK
 
@@ -248,29 +250,49 @@ def _marshal_group(entries, rand_fn):
     n = len(entries)
     C = _next_pow2((n + S - 1) // S)
     K = _next_pow2(max(len(e[1]) for e in entries))
+
+    nkeys = np.fromiter((len(e[1]) for e in entries), np.int64, n)
+    total_keys = int(nkeys.sum())
+    flat_idx = np.fromiter(
+        (_PK_TABLE.index_of(kp) for e in entries for kp in e[1]),
+        np.int32, total_keys)
+    sets = np.arange(n)
+    c_arr, s_arr = sets // S, sets % S
+    starts = np.concatenate([[0], np.cumsum(nkeys)[:-1]])
+    within = np.arange(total_keys) - np.repeat(starts, nkeys)
+    kcol = (np.repeat(c_arr * K * S + s_arr, nkeys)
+            + within.astype(np.int64) * S)
     idx = np.zeros(C * K * S, np.int32)
     kmask = np.zeros((1, C * K * S), np.int32)
+    idx[kcol] = flat_idx
+    kmask[0, kcol] = 1
+
+    rands = np.fromiter((rand_fn() for _ in range(n)), np.uint64, n)
     lo = np.zeros((1, C * S), np.uint32)
     hi = np.zeros((1, C * S), np.uint32)
+    set_col = c_arr * S + s_arr
+    lo[0, set_col] = (rands & 0xFFFFFFFF).astype(np.uint32)
+    hi[0, set_col] = (rands >> 32).astype(np.uint32)
+
+    u_cols = np.frombuffer(
+        b"".join(HK._u_cols(bytes(e[2])) for e in entries),
+        np.uint32).reshape(n, 2, 2 * HK.BLOCK_ROWS)
+    u_planes = np.zeros((2 * HK.BLOCK_ROWS, C * 2 * S), np.uint32)
+    ubase = c_arr * 2 * S + s_arr
+    u_planes[:, ubase] = u_cols[:, 0].T
+    u_planes[:, ubase + S] = u_cols[:, 1].T
+
     sig_cols = np.zeros((128, C * S), np.uint32)
     lane_mask = np.zeros((1, C * 2 * S), np.int32)
-    messages = []
-    for s0, (sig_pt, keys, msg) in enumerate(entries):
-        c, s = divmod(s0, S)
-        kbase = c * K * S
-        for k, kp in enumerate(keys):
-            idx[kbase + k * S + s] = _PK_TABLE.index_of(kp)
-        kmask[0, kbase + S * np.arange(len(keys)) + s] = 1
-        rand = rand_fn()
-        lo[0, c * S + s] = rand & 0xFFFFFFFF
-        hi[0, c * S + s] = rand >> 32
-        messages.append((c, s, bytes(msg)))
-        lane_mask[0, c * 2 * S + s] = 1
-        if sig_pt is not None:
-            sig_cols[:, c * S + s] = np.frombuffer(_g2_aff_col(sig_pt),
-                                                   np.uint32)
-            lane_mask[0, c * 2 * S + S + s] = 1
-    u_planes = HK.u_planes_for_messages(messages, C)
+    lane_mask[0, c_arr * 2 * S + s_arr] = 1
+    have_sig = np.fromiter((e[0] is not None for e in entries), bool, n)
+    if have_sig.any():
+        sig_bytes = b"".join(_g2_aff_col(e[0])
+                             for e in entries if e[0] is not None)
+        cols = np.frombuffer(sig_bytes, np.uint32).reshape(-1, 128).T
+        sig_cols[:, set_col[have_sig]] = cols
+        lane_mask[0, (c_arr * 2 * S + S + s_arr)[have_sig]] = 1
+
     setlive = lane_mask.reshape(C, 2, S)[:, 0, :].reshape(1, C * S)
     return (jnp.asarray(idx), jnp.asarray(kmask), jnp.asarray(lo),
             jnp.asarray(hi), jnp.asarray(u_planes), jnp.asarray(sig_cols),
