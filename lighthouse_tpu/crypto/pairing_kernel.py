@@ -842,6 +842,95 @@ def product_chunks_kernel_call(f_planes, mask):
 
 
 # ---------------------------------------------------------------------------
+# Sigma kernel: per-chunk RLC-scaled signature aggregation (G2)
+# ---------------------------------------------------------------------------
+#
+# The signature side of the batch equation collapses to ONE pairing lane:
+#     ∏ e(−c_i·G, σ_i) = e(−G, Σ c_i·σ_i)
+# so instead of one Miller lane per set, each 128-set chunk runs a 64-bit
+# G2 double-and-add ladder (the same RLC scalars as the pk side) and a
+# lane butterfly to fold the chunk's scaled signatures into one point;
+# the XLA glue combines the per-chunk partials and hands the single
+# aggregate to a dedicated Miller cell paired with the constant −G.
+
+
+def _sigma_kernel(cref, xbits_ref, pbits_ref, sig_ref, mask_ref, lo_ref,
+                  hi_ref, out_ref):
+    _bind_consts(cref, xbits_ref, pbits_ref)
+    S = PREP_S
+    cols = unpack_fq2s(sig_ref[:], 2)  # [x, y] as Fq2 planes
+    live = mask_ref[:] != 0
+    pt = point_select(_G2ops, live,
+                      (cols[0], cols[1], _G2ops.one_like(S)),
+                      point_identity(_G2ops, S))
+    scaled = scalar_mul(_G2ops, pt, lo_ref[:], hi_ref[:])
+    # Butterfly fold: after log2(S) roll-multiplies every lane holds the
+    # full chunk sum.
+    w = S // 2
+    while w >= 1:
+        rolled = tuple(
+            (_roll_lanes(c0, w), _roll_lanes(c1, w)) for (c0, c1) in scaled)
+        scaled = point_add(_G2ops, scaled, rolled)
+        w //= 2
+    out_ref[:] = pack_planes([scaled[0][0], scaled[0][1],
+                              scaled[1][0], scaled[1][1],
+                              scaled[2][0], scaled[2][1]])
+
+
+@jax.jit
+def sigma_kernel_call(sig_cols, mask, lo, hi):
+    """sig (128, C·128) affine G2 signature columns, mask/lo/hi (1, C·128)
+    → (192, C·128) projective per-chunk Σ c_s·σ_s (every lane of a chunk's
+    block holds that chunk's full sum)."""
+    m = sig_cols.shape[1]
+    if m % PREP_S:
+        raise ValueError("sigma lanes must be C · 128")
+    C = m // PREP_S
+    return pl.pallas_call(
+        _sigma_kernel,
+        grid=(C,),
+        in_specs=_const_specs() + [
+            pl.BlockSpec((4 * BLOCK_ROWS, PREP_S), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, PREP_S), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, PREP_S), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, PREP_S), lambda c: (0, c),
+                         memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((6 * BLOCK_ROWS, PREP_S), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((6 * BLOCK_ROWS, C * PREP_S),
+                                       jnp.uint32),
+        compiler_params=_COMPILER_PARAMS,
+    )(*_const_args(), sig_cols, mask, lo, hi)
+
+
+def sigma_combine(partials):
+    """(192, C·128) per-chunk projective partials → affine Σ over chunks
+    as ONE miller-ready G2 column (128,) — XLA glue (tiny work, once per
+    verify call).  Returns (g2_col, is_identity)."""
+    m = partials.shape[1]
+    C = m // PREP_S
+    # lane c·128 of each chunk block → (C, 3, 2, 26) limb layout
+    comps = partials.reshape(6, BLOCK_ROWS, m)[:, :LIMBS, :]  # (6, 26, m)
+    pts = comps[:, :, ::PREP_S]                               # (6, 26, C)
+    pts = jnp.transpose(pts, (2, 0, 1)).reshape(C, 3, 2, LIMBS)
+    from . import limb_curve as LC
+    acc = pts[0]
+    for c in range(1, C):
+        acc = LC.point_add(LC.G2_OPS, acc, pts[c])
+    is_ident = XP.T.fq2_is_zero(acc[2])
+    aff = XP.g2_proj_to_affine(acc[None])[0]                  # (2, 2, 26)
+    col = jnp.zeros((4 * BLOCK_ROWS,), jnp.uint32)
+    col = col.at[0:LIMBS].set(aff[0, 0])
+    col = col.at[BLOCK_ROWS:BLOCK_ROWS + LIMBS].set(aff[0, 1])
+    col = col.at[2 * BLOCK_ROWS:2 * BLOCK_ROWS + LIMBS].set(aff[1, 0])
+    col = col.at[3 * BLOCK_ROWS:3 * BLOCK_ROWS + LIMBS].set(aff[1, 1])
+    return col, is_ident
+
+
+# ---------------------------------------------------------------------------
 # Finalize kernel: full lane fold + in-kernel final exponentiation
 # ---------------------------------------------------------------------------
 
@@ -1004,15 +1093,7 @@ def _prepare_kernel(cref, xbits_ref, pbits_ref, pk_ref, kmask_ref, lo_ref,
     # Live sets with identity aggregates are invalid (blst/PythonBackend
     # rule); reported per-lane and folded into the batch verdict.
     flags_ref[:] = (k_is_zero(acc[2])).astype(jnp.int32)
-    # Lanes [0:S] = c_i · aggpk_i; lanes [S:2S] = −c_i · G.
-    negg = (jnp.broadcast_to(_KC["NEGG_X"], (LIMBS, S)),
-            jnp.broadcast_to(_KC["NEGG_Y"], (LIMBS, S)),
-            _G1ops.one_like(S))
-    pts = tuple(jnp.concatenate([a, b], axis=1)
-                for a, b in zip(acc, negg))
-    lo2 = jnp.concatenate([lo_ref[:], lo_ref[:]], axis=1)
-    hi2 = jnp.concatenate([hi_ref[:], hi_ref[:]], axis=1)
-    scaled = scalar_mul(_G1ops, pts, lo2, hi2)
+    scaled = scalar_mul(_G1ops, acc, lo_ref[:], hi_ref[:])
     zi = k_fq_inv(scaled[2])
     xa = k_mont_mul(scaled[0], zi)
     ya = k_mont_mul(scaled[1], zi)
@@ -1026,12 +1107,11 @@ def prepare_kernel_call(pk_planes, kmask, lo, hi, *, K: int):
     (1, C·K·128) int32; lo/hi (1, C·128) uint32 RLC scalar words.  The
     grid runs one cell per 128-set chunk.
 
-    Returns (g1_aff (64, C·256) blocks, ident_flags (1, C·128) int32):
-    per chunk, lanes [0:128] are the affine c_i·aggpk_i (pair them with
-    H(m_i)), lanes [128:256] the affine −c_i·G (pair them with σ_i) — the
-    signature side of the RLC is carried by the pairing bilinearity
-    instead of a G2 ladder:
-    ∏ e(c_i·pk_i, H_i) · ∏ e(−c_i·G, σ_i) == 1.
+    Returns (g1_aff (64, C·128) blocks, ident_flags (1, C·128) int32):
+    lane s of chunk c holds the affine c_i·aggpk_i, to be paired with
+    H(m_i).  The signature side of the RLC lives in ONE extra Miller
+    lane built by :func:`sigma_kernel_call` + :func:`sigma_combine`:
+    ∏ e(c_i·aggpk_i, H_i) · e(−G, Σ c_i·σ_i) == 1.
     """
     S = PREP_S
     if pk_planes.shape[1] % (K * S):
@@ -1047,11 +1127,11 @@ def prepare_kernel_call(pk_planes, kmask, lo, hi, *, K: int):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S), lambda c: (0, c), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, S), lambda c: (0, c), memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec((2 * BLOCK_ROWS, 2 * S), lambda c: (0, c),
+        out_specs=(pl.BlockSpec((2 * BLOCK_ROWS, S), lambda c: (0, c),
                                 memory_space=pltpu.VMEM),
                    pl.BlockSpec((1, S), lambda c: (0, c),
                                 memory_space=pltpu.VMEM)),
-        out_shape=(jax.ShapeDtypeStruct((2 * BLOCK_ROWS, 2 * S * C),
+        out_shape=(jax.ShapeDtypeStruct((2 * BLOCK_ROWS, S * C),
                                         jnp.uint32),
                    jax.ShapeDtypeStruct((1, S * C), jnp.int32)),
         compiler_params=_COMPILER_PARAMS,

@@ -194,28 +194,58 @@ class _DevicePubkeyTable:
 _PK_TABLE = _DevicePubkeyTable()
 
 
+def _sigma_g1_cell() -> np.ndarray:
+    """(64, 128) Miller-cell G1 input whose lane 0 is the affine −G (the
+    pair of the aggregated-signature lane); other lanes are masked."""
+    out = np.zeros((64, 128), np.uint32)
+    out[:, 0] = np.frombuffer(_g1_aff_col(C.g1_neg(C.G1_GEN)), np.uint32)
+    return out
+
+
+_SIGMA_G1_CELL = _sigma_g1_cell()
+
+
 @partial(jax.jit, static_argnames=("K",))
 def _fused_pipeline(table, idx, kmask, lo, hi, u_planes, sig_cols,
-                    lane_mask, setlive, *, K: int):
+                    sigmask, setlive, *, K: int):
     """Batch verify up to the 128-class lane products, as one device
     program per (C, K, capacity) shape bucket: pubkey gather →
     hash-to-curve of every message → prepare (G1 aggregation + RLC
-    ladders) → batched Miller loops → per-chunk lane folds → (384, 128)
-    residue products + bad-aggregate flag."""
+    ladder) → per-chunk RLC signature aggregation (the σ side collapses
+    to ONE Miller lane via e(−G, Σ c_i·σ_i)) → batched Miller loops →
+    per-chunk lane folds → (384, 128) residue products + bad flag."""
     from . import pairing_kernel as PK
     from . import htc_kernel as HK
 
     S = PK.PREP_S
     C = sig_cols.shape[1] // S
     pk = jnp.take(table, idx, axis=1)                   # (64, C·K·S)
-    g1_aff, flags = PK.prepare_kernel_call(pk, kmask, lo, hi, K=K)
+    g1_aggpk, flags = PK.prepare_kernel_call(pk, kmask, lo, hi, K=K)
     h_cols = HK.hash_g2_kernel_call(u_planes)           # (128, C·S)
-    g2 = jnp.stack([h_cols.reshape(128, C, S),
-                    sig_cols.reshape(128, C, S)],
-                   axis=2).reshape(128, C * 2 * S)
-    f = PK.miller_kernel_call(g1_aff, g2)
+    partials = PK.sigma_kernel_call(sig_cols, sigmask, lo, hi)
+    sig_col, sig_ident = PK.sigma_combine(partials)
+
+    lanes = (C + 1) * S
+    pad = (-lanes) % (2 * S)
+    g1 = jnp.concatenate(
+        [g1_aggpk, jnp.asarray(_SIGMA_G1_CELL)]
+        + ([jnp.zeros((64, pad), jnp.uint32)] if pad else []), axis=1)
+    g2_sig = jnp.zeros((128, S), jnp.uint32).at[:, 0].set(sig_col)
+    g2 = jnp.concatenate(
+        [h_cols, g2_sig]
+        + ([jnp.zeros((128, pad), jnp.uint32)] if pad else []), axis=1)
+    sig_live = jnp.any(sigmask != 0) & ~sig_ident
+    sig_cell_mask = jnp.zeros((1, S), jnp.int32).at[0, 0].set(
+        sig_live.astype(jnp.int32))
+    lane_mask = jnp.concatenate(
+        [setlive, sig_cell_mask]
+        + ([jnp.zeros((1, pad), jnp.int32)] if pad else []), axis=1)
+
+    f = PK.miller_kernel_call(g1, g2)
     prod = PK.product_chunks_kernel_call(f, lane_mask)
     while prod.shape[1] > PK.LANE_BLOCK:
+        if (prod.shape[1] // PK.LANE_BLOCK) % 2:  # odd block count
+            prod = jnp.concatenate([prod, jnp.asarray(_ONE_BLOCK)], axis=1)
         ones = jnp.ones((1, prod.shape[1]), jnp.int32)
         prod = PK.product_chunks_kernel_call(prod, ones)
     bad = jnp.any((flags != 0) & (setlive != 0))
@@ -283,30 +313,29 @@ def _marshal_group(entries, rand_fn):
     u_planes[:, ubase + S] = u_cols[:, 1].T
 
     sig_cols = np.zeros((128, C * S), np.uint32)
-    lane_mask = np.zeros((1, C * 2 * S), np.int32)
-    lane_mask[0, c_arr * 2 * S + s_arr] = 1
+    sigmask = np.zeros((1, C * S), np.int32)
     have_sig = np.fromiter((e[0] is not None for e in entries), bool, n)
     if have_sig.any():
         sig_bytes = b"".join(_g2_aff_col(e[0])
                              for e in entries if e[0] is not None)
         cols = np.frombuffer(sig_bytes, np.uint32).reshape(-1, 128).T
         sig_cols[:, set_col[have_sig]] = cols
-        lane_mask[0, (c_arr * 2 * S + S + s_arr)[have_sig]] = 1
-
-    setlive = lane_mask.reshape(C, 2, S)[:, 0, :].reshape(1, C * S)
+        sigmask[0, set_col[have_sig]] = 1
+    setlive = np.zeros((1, C * S), np.int32)
+    setlive[0, set_col] = 1
     return (jnp.asarray(idx), jnp.asarray(kmask), jnp.asarray(lo),
             jnp.asarray(hi), jnp.asarray(u_planes), jnp.asarray(sig_cols),
-            jnp.asarray(lane_mask),
-            jnp.asarray(np.ascontiguousarray(setlive)), K)
+            jnp.asarray(sigmask), jnp.asarray(setlive), K)
 
 
 def _dispatch_pallas(entries, rand_fn) -> bool:
     """Marshal a batch and run the fused device pipeline:
 
-        ∏ e(c_i·aggpk_i, H(m_i)) · ∏ e(−c_i·G, σ_i) == 1
+        ∏ e(c_i·aggpk_i, H(m_i)) · e(−G, Σ c_i·σ_i) == 1
 
-    (the signature side of the RLC rides the pairing bilinearity — no G2
-    ladder).  Sets group by K = next-pow2(signer count) so one 512-key
+    (the signature side of the RLC collapses to one pairing lane — the
+    same aggregation blst's ``verify_multiple_aggregate_signatures``
+    performs).  Sets group by K = next-pow2(signer count) so one 512-key
     sync-committee set doesn't pad a thousand single-key sets to K=512;
     each group runs its own pipeline dispatch, every group's (384, 128)
     residue products concat into ONE shared finalize (fold + final
@@ -323,9 +352,9 @@ def _dispatch_pallas(entries, rand_fn) -> bool:
     args = [_marshal_group(groups[k], rand_fn) for k in sorted(groups)]
     table = _PK_TABLE.device()  # after marshalling registered new keys
     prods, bads = [], []
-    for (idx, kmask, lo, hi, u, sig, lm, setlive, K) in args:
+    for (idx, kmask, lo, hi, u, sig, sigmask, setlive, K) in args:
         prod, bad = _fused_pipeline(table, idx, kmask, lo, hi, u, sig,
-                                    lm, setlive, K=K)
+                                    sigmask, setlive, K=K)
         prods.append(prod)
         bads.append(bad)
     g = _next_pow2(len(prods))
