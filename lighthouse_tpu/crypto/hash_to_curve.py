@@ -9,14 +9,13 @@ Pipeline per RFC 9380: expand_message_xmd(SHA-256) -> 2 Fq2 field elements
 -> simplified SWU onto the 3-isogenous curve E' (A' = 240u, B' = 1012(1+u),
 Z = -(2+u)) -> 3-isogeny to E -> point add -> cofactor clearing.
 
-Validation status (no external vectors are available in this offline
-environment): the isogeny constants are checked structurally in tests —
-iso_map must send E'(Fq2) points onto E(Fq2) and be a group homomorphism,
-which a wrong coefficient breaks with overwhelming probability.  Cofactor
-clearing uses RFC 9380's effective cofactor h_eff, cross-checked against the
-true cofactor h2 = #E'(Fq2)/r derived from the family trace (h_eff is an
-exact multiple of h2 with r-coprime quotient).  Re-confirm against official
-vectors in the conformance round.
+Validation status: externally anchored.  ``tests/test_external_vectors.py``
+pins this pipeline to the published RFC 9380 known answers — Appendix
+J.10.1 (`BLS12381G2_XMD:SHA-256_SSWU_RO_` u-values and output points) and
+Appendix K.1 (`expand_message_xmd` SHA-256) — plus the eth2 interop
+keypairs; all match exactly.  Structural checks (iso_map homomorphism onto
+E(Fq2); h_eff an exact multiple of the true twist cofactor with r-coprime
+quotient) remain in the suite as fast invariants.
 """
 
 from __future__ import annotations

@@ -246,23 +246,29 @@ def k_clear_cofactor(p):
 def _hash_g2_kernel(cref, xbits_ref, pbits_ref, e16_ref, u_ref, out_ref):
     _bind_consts(cref, xbits_ref, pbits_ref)
     _KC["e16"] = e16_ref
+    # in_mosaic is a trace-time flag: scope it to this trace so an eager /
+    # interpret drive of the k_* helpers afterwards doesn't inherit it
+    # (pltpu.repeat outside Mosaic would crash — ADVICE r4).
     _KC["in_mosaic"] = True
-    M = LANE_BLOCK
-    planes = unpack_planes(u_ref[:], 2)
-    t = (planes[0], planes[1])                  # (26, 2M): [u0 | u1] blocks
-    x, y = k_sswu_map(t)
-    q = k_iso_map_proj(x, y)
-    # Combine u0 + u1: roll the lane halves together (aligned 128-concat).
-    rolled = tuple((jnp.concatenate([c0[:, M:], c0[:, :M]], axis=1),
-                    jnp.concatenate([c1[:, M:], c1[:, :M]], axis=1))
-                   for (c0, c1) in q)
-    p = point_add(_G2ops, q, rolled)
-    p = tuple((c0[:, :M], c1[:, :M]) for (c0, c1) in p)
-    p = k_clear_cofactor(p)
-    zi = fq2_inv(p[2])
-    xa = fq2_mul(p[0], zi)
-    ya = fq2_mul(p[1], zi)
-    out_ref[:] = pack_planes([xa[0], xa[1], ya[0], ya[1]])
+    try:
+        M = LANE_BLOCK
+        planes = unpack_planes(u_ref[:], 2)
+        t = (planes[0], planes[1])              # (26, 2M): [u0 | u1] blocks
+        x, y = k_sswu_map(t)
+        q = k_iso_map_proj(x, y)
+        # Combine u0 + u1: roll lane halves together (aligned 128-concat).
+        rolled = tuple((jnp.concatenate([c0[:, M:], c0[:, :M]], axis=1),
+                        jnp.concatenate([c1[:, M:], c1[:, :M]], axis=1))
+                       for (c0, c1) in q)
+        p = point_add(_G2ops, q, rolled)
+        p = tuple((c0[:, :M], c1[:, :M]) for (c0, c1) in p)
+        p = k_clear_cofactor(p)
+        zi = fq2_inv(p[2])
+        xa = fq2_mul(p[0], zi)
+        ya = fq2_mul(p[1], zi)
+        out_ref[:] = pack_planes([xa[0], xa[1], ya[0], ya[1]])
+    finally:
+        _KC["in_mosaic"] = False
 
 
 @jax.jit

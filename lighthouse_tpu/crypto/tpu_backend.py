@@ -145,9 +145,12 @@ class _DevicePubkeyTable:
 
     New columns append with a device-side ``.at[].set`` (a 256-byte h2d +
     on-device copy — never a full-table re-upload); capacity doubling pads
-    on-device.  Bounded by ``max_keys`` (≈ a registry's worth): beyond it
-    the table resets rather than growing without bound under adversarial
-    never-seen keys."""
+    on-device.  Bounded by ``max_keys`` (≈ a registry's worth): at the
+    bound the LEAST-RECENTLY-USED half of the keys is evicted and the
+    survivors compacted (generational halving), so adversarial never-seen
+    keys can't grow the table without bound while hot validator keys stay
+    resident; the next ``device()`` call re-uploads the compacted table
+    once."""
 
     def __init__(self, initial: int = 1 << 15, max_keys: int = 1 << 21):
         self._initial = initial
@@ -156,17 +159,43 @@ class _DevicePubkeyTable:
 
     def _reset(self) -> None:
         self._index: dict = {}
+        self._last_used: dict = {}
+        self._gen = 0
         self._host = np.zeros((64, self._initial), np.uint32)
         self._n = 1  # column 0 stays zero for masked slots
         self._device = None
 
     def maybe_reset(self) -> None:
-        """Call BETWEEN batches only: resetting mid-marshal would
-        invalidate indices already recorded for the in-flight batch."""
-        if self._n >= self._max_keys:
-            self._reset()
+        """Call BETWEEN batches only: evicting mid-marshal would
+        invalidate indices already recorded for the in-flight batch.
+
+        Generational halving (ADVICE r4): at the bound, keep the most
+        recently USED half of the keys and compact, instead of dropping
+        the whole table — a full reset would force a re-marshal +
+        re-upload of every hot validator key in one latency spike on the
+        block-verification path.  Recency (not insertion order) decides
+        survival: hot validator keys are touched by every batch they
+        appear in, so a flood of adversarial never-seen keys ages out
+        while the working set stays resident."""
+        self._gen += 1
+        if self._n < self._max_keys:
+            return
+        keep = (self._n - 1) // 2
+        survivors = sorted(
+            self._index.items(),
+            key=lambda kv: (self._last_used.get(kv[0], 0), kv[1]),
+            reverse=True)[:keep]
+        survivors.sort(key=lambda kv: kv[1])  # stable column order
+        host = np.zeros((64, self._host.shape[1]), np.uint32)
+        cols = [old for _, old in survivors]
+        host[:, 1:len(cols) + 1] = self._host[:, cols]  # one gather
+        index = {pt: i + 1 for i, (pt, _) in enumerate(survivors)}
+        self._host, self._index, self._n = host, index, len(cols) + 1
+        self._last_used = {pt: self._last_used.get(pt, 0) for pt in index}
+        self._device = None  # next device() re-uploads the compacted table
 
     def index_of(self, point) -> int:
+        self._last_used[point] = self._gen
         i = self._index.get(point)
         if i is None:
             if self._n == self._host.shape[1]:

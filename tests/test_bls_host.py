@@ -234,3 +234,33 @@ def test_fake_backend():
         assert not bls.verify_signature_sets([])
     finally:
         bls.set_backend("python")
+
+
+def test_pubkey_table_lru_eviction():
+    """Generational LRU halving (ADVICE r4): hot keys touched every batch
+    stay resident; junk from earlier batches ages out; columns survive
+    compaction bit-exact."""
+    import numpy as np
+    from lighthouse_tpu.crypto import tpu_backend as TB
+
+    tbl = TB._DevicePubkeyTable(initial=8, max_keys=16)
+    hot = [bls.SecretKey(1000 + i).public_key().point for i in range(4)]
+    junk = [bls.SecretKey(5000 + i).public_key().point for i in range(24)]
+    ji = 0
+    for _ in range(6):
+        for p in hot:
+            tbl.index_of(p)
+        for p in junk[ji:ji + 4]:   # bounded junk per batch (64-set queues)
+            tbl.index_of(p)
+        ji += 4
+        tbl.maybe_reset()
+    assert tbl._n <= 16
+    for p in hot:
+        i = tbl._index.get(p)
+        assert i is not None, "hot key evicted by junk stream"
+        assert (tbl._host[:, i] ==
+                np.frombuffer(TB._g1_aff_col(p), np.uint32)).all()
+    # Evicted keys re-insert cleanly.
+    j = tbl.index_of(junk[0])
+    assert (tbl._host[:, j] ==
+            np.frombuffer(TB._g1_aff_col(junk[0]), np.uint32)).all()

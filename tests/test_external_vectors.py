@@ -1,0 +1,153 @@
+"""Published external test vectors for the crypto stack (VERDICT r4 #3).
+
+Until this round the SSWU/isogeny/cofactor pipeline had only been checked
+for structural self-consistency; these are the published known answers,
+embedded as hex constants:
+
+- RFC 9380 Appendix J.10.1 — ``BLS12381G2_XMD:SHA-256_SSWU_RO_`` with
+  DST ``QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_``: the
+  ``hash_to_field`` u-values and the final output point P for the RFC's
+  fixed messages.  A match here pins expand_message_xmd, hash_to_field,
+  the SSWU map onto E', the 3-isogeny, point addition, and the effective
+  cofactor — i.e. the entire H(m) used by every signature in the system
+  (the reference gets this from blst,
+  ``/root/reference/crypto/bls/src/impls/blst.rs:14``).
+- RFC 9380 Appendix K.1 — ``expand_message_xmd`` (SHA-256) with DST
+  ``QUUX-V01-CS02-with-expander-SHA256-128``.
+- The Ethereum 2.0 interop BLS keypairs (eth2.0-pm interop spec; also
+  exercised across the reference's test-suite) — pins G1 scalar
+  multiplication and the ZCash-style compressed serialization.
+
+The tpu backend shares the host ``expand_message``/``hash_to_field`` and
+re-implements the curve half in the Pallas HTC kernel, whose helpers are
+cross-checked against this (now externally anchored) host oracle in
+``test_htc_kernel_cpu.py``; the lowered kernel is compared on-chip in
+``test_pairing_kernel.py``/``bench.py``.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.hash_to_curve import (
+    expand_message_xmd, hash_to_field_fq2, hash_to_g2)
+
+RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+XMD_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+
+# RFC 9380 J.10.1: message -> ((x_c0, x_c1), (y_c0, y_c1)), affine.
+H2C_G2_VECTORS = {
+    b"": (
+        ("0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d"
+         "4ac44c1038e9dcdd5393faf5c41fb78a",
+         "05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff"
+         "5bf5dd71b72418717047f5b0f37da03d"),
+        ("0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec0"
+         "76daf2d4bc358c4b190c0c98064fdd92",
+         "12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395"
+         "c3c811cdd19f1e8dbf3e9ecfdcbab8d6"),
+    ),
+    b"abc": (
+        ("02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbe"
+         "c7780ccc7954725f4168aff2787776e6",
+         "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4"
+         "ca3a230ed250fbe3a2acf73a41177fd8"),
+        ("1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244a"
+         "eb197642555a0645fb87bf7466b2ba48",
+         "00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e"
+         "1ce70dd94a733534f106d4cec0eddd16"),
+    ),
+    b"abcdef0123456789": (
+        ("121982811d2491fde9ba7ed31ef9ca474f0e1501297f68c298e9f4c0028add35"
+         "aea8bb83d53c08cfc007c1e005723cd0",
+         "190d119345b94fbd15497bcba94ecf7db2cbfd1e1fe7da034d26cbba169fb396"
+         "8288b3fafb265f9ebd380512a71c3f2c"),
+        ("05571a0f8d3c08d094576981f4a3b8eda0a8e771fcdcc8ecceaf1356a6acf175"
+         "74518acb506e435b639353c2e14827c8",
+         "0bb5e7572275c567462d91807de765611490205a941a5a6af3b1691bfe596c31"
+         "225d3aabdf15faff860cb4ef17c7c3be"),
+    ),
+}
+
+# RFC 9380 J.10.1: hash_to_field u-values for msg = "".
+H2C_U_EMPTY = (
+    ("03dbc2cce174e91ba93cbb08f26b917f98194a2ea08d1cce75b2b9cc9f21689d"
+     "80bd79b594a613d0a68eb807dfdc1cf8",
+     "05a2acec64114845711a54199ea339abd125ba38253b70a92c876df10598bd19"
+     "86b739cad67961eb94f7076511b3b39a"),
+    ("02f99798e8a5acdeed60d7e18e9120521ba1f47ec090984662846bc825de191b"
+     "5b7641148c0dbc237726a334473eee94",
+     "145a81e418d4010cc027a68f14391b30074e89e60ee7a22f87217b2f6eb0c4b9"
+     "4c9115b436e6fa4607e95a98de30a435"),
+)
+
+# RFC 9380 K.1: expand_message_xmd(SHA-256), len_in_bytes = 0x20.
+XMD_VECTORS = {
+    b"": "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235",
+    b"abc":
+        "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615",
+    b"abcdef0123456789":
+        "eff31487c770a893cfb36f912fbfcbff40d5661771ca4b2cb4eafe524333f5c1",
+    b"q128_" + b"q" * 128:
+        "b23a1d2b4d97b2ef7785562a7e8bac7eed54ed6e97e29aa51bfe3f12ddad1ff9",
+    b"a512_" + b"a" * 512:
+        "4623227bcc01293b8c130bf771da8c298dede7383243dc0993d2d94823958c4c",
+}
+
+# Ethereum 2.0 interop BLS keypairs: secret scalar -> compressed pubkey.
+INTEROP_KEYS = [
+    ("263dbd792f5b1be47ed85f8938c0f29586af0d3ac7b977f21c278fe1462040e3",
+     "a491d1b0ecd9bb917989f0e74f0dea0422eac4a873e5e2644f368dffb9a6e20f"
+     "d6e10c1b77654d067c0618f6e5a7f79a"),
+    ("47b8192d77bf871b62e87859d653922725724a5c031afeabc60bcef5ff665138",
+     "b301803f8b5ac4a1133581fc676dfedc60d891dd5fa99028805e5ea5b08d3491"
+     "af75d0707adab3b70c6a6a580217bf81"),
+    ("328388aff0d4a5b7dc9205abd374e7e98f3cd9f3418edb4eafda5fb16473d216",
+     "b53d21a4cfd562c469cc81514d4ce5a6b577d8403d32a394dc265dd190b47fa9"
+     "f829fdd7963afdf972e5e77854051f6f"),
+]
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("msg,expected", list(XMD_VECTORS.items()),
+                         ids=["empty", "abc", "abcdef", "q128", "a512"])
+def test_expand_message_xmd_rfc9380_k1(msg, expected):
+    assert expand_message_xmd(msg, XMD_DST, 0x20).hex() == expected
+
+
+@pytest.mark.quick
+def test_hash_to_field_rfc9380_empty_msg():
+    u = hash_to_field_fq2(b"", 2, RFC_DST)
+    got = [(format(c0, "096x"), format(c1, "096x")) for c0, c1 in u]
+    assert got == [tuple(v) for v in H2C_U_EMPTY]
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("msg", list(H2C_G2_VECTORS),
+                         ids=["empty", "abc", "abcdef"])
+def test_hash_to_g2_rfc9380_j10(msg):
+    (x0, x1), (y0, y1) = hash_to_g2(msg, RFC_DST)
+    (ex, ey) = H2C_G2_VECTORS[msg]
+    assert (format(x0, "096x"), format(x1, "096x")) == ex
+    assert (format(y0, "096x"), format(y1, "096x")) == ey
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("sk_hex,pk_hex", INTEROP_KEYS,
+                         ids=["interop0", "interop1", "interop2"])
+def test_interop_pubkeys(sk_hex, pk_hex):
+    sk = bls.SecretKey(int(sk_hex, 16))
+    assert sk.public_key().serialize().hex() == pk_hex
+    # And the roundtrip through deserialize validates the encoding rules.
+    assert bls.PublicKey.deserialize(bytes.fromhex(pk_hex)).point == \
+        sk.public_key().point
+
+
+@pytest.mark.quick
+def test_sign_verify_under_rfc_anchored_hash():
+    """With H(m) pinned to RFC 9380 and pubkeys pinned to interop vectors,
+    a sign/verify roundtrip transitively anchors the eth2 DST path too
+    (same pipeline, production DST)."""
+    sk = bls.SecretKey(int(INTEROP_KEYS[0][0], 16))
+    sig = sk.sign(b"message")
+    assert sig.verify(sk.public_key(), b"message")
+    assert not sig.verify(sk.public_key(), b"message2")
