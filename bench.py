@@ -13,8 +13,12 @@ hash-to-curve runs on-device every call; the device pubkey table is the
 Also measured (BASELINE rows 2-5 + latency tier):
 
 - ``single_set_verify_ms`` — one proposer-signature set (the gossip-block
-  check, `block_verification.py`).  Note the axon tunnel contributes
-  ~100 ms fixed roundtrip latency per device sync.
+  check, `block_verification.py`), routed through the native C++ host
+  pairing for tiny batches (``tpu_backend._host_fastpath_max``): the axon
+  tunnel contributes ~100 ms fixed roundtrip per device sync, so n≤4 sets
+  verify on-host (~8 ms native 2-pairing + ~21 ms python hash-to-curve).
+  Co-located deployments (µs dispatch) set
+  LIGHTHOUSE_TPU_HOST_FASTPATH_MAX=0 to keep the device path.
 - ``fast_aggregate_verify_512x256_ms`` — 256 sets × 512 shared pubkeys
   (sync-committee shape, BASELINE row 4).
 - ``registry_htr_ms`` — fused-Pallas `hash_tree_root` of a 2^21-validator

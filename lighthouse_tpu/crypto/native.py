@@ -1,0 +1,157 @@
+"""ctypes bindings for the native BLS12-381 runtime (``native/bls381.cpp``).
+
+The native library is the HOST fast path: single-set / small-batch
+verification where the TPU's fixed dispatch latency (~100 ms through the
+axon tunnel) dominates, and the fast oracle for tests.  Large batches stay
+on the TPU (`pairing_kernel.py`).  This is the tpu-native analogue of the
+reference's blst host calls (``/root/reference/crypto/bls/src/impls/
+blst.rs``) — portable C++ (no asm), built on demand with g++.
+
+Build model: the checked-in source is compiled lazily to
+``native/libbls381.so`` keyed on a source hash; rebuilds happen only when
+``bls381.cpp`` / ``bls381_consts.h`` change.  If no compiler is available
+the loader degrades to ``available() == False`` and callers fall back to
+the pure-python pairing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    os.pardir, os.pardir, "native")
+_SRC = os.path.join(_DIR, "bls381.cpp")
+_HDR = os.path.join(_DIR, "bls381_consts.h")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _source_tag() -> str:
+    h = hashlib.sha256()
+    for path in (_SRC, _HDR):
+        with open(path, "rb") as f:
+            h.update(f.read())
+    # -march=native binaries are host-specific: fingerprint the CPU's
+    # feature flags so a .so baked on one machine (e.g. into an image)
+    # is rebuilt rather than SIGILL-ing on a lesser deploy host.
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    h.update(line.encode())
+                    break
+    except OSError:
+        import platform
+        h.update(platform.processor().encode())
+    return h.hexdigest()[:16]
+
+
+def _build() -> Optional[str]:
+    tag = _source_tag()
+    so = os.path.join(_DIR, f"libbls381-{tag}.so")
+    if os.path.exists(so):
+        return so
+    tmp = so + ".tmp%d" % os.getpid()
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(tmp, so)  # atomic vs concurrent builders
+    return so
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.bls381_multi_pairing_is_one.restype = ctypes.c_int
+        lib.bls381_multi_pairing_is_one.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+        lib.bls381_multi_pairing_gt.restype = None
+        lib.bls381_multi_pairing_gt.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        _lib = lib
+        return _lib
+
+
+def available(block: bool = True) -> bool:
+    """Whether the native library is loadable.
+
+    ``block=False`` never compiles or waits: it answers from the cached
+    state only (hot paths use this — a fresh checkout answers False and
+    batches stay on the device until :func:`prebuild_async` finishes)."""
+    if _lib is not None:
+        return True
+    if not block:
+        return False if not _tried else _lib is not None
+    return _load() is not None
+
+
+def prebuild_async() -> None:
+    """Kick the g++ build/load on a daemon thread so the first verify
+    never pays the compile synchronously (started at backend import)."""
+    if _lib is not None or _tried:
+        return
+    threading.Thread(target=_load, name="bls381-native-build",
+                     daemon=True).start()
+
+
+def _limbs(x: int) -> Tuple[int, ...]:
+    return tuple((x >> (64 * i)) & 0xFFFFFFFFFFFFFFFF for i in range(6))
+
+
+def _pack(pairs: Sequence[Tuple[tuple, tuple]]):
+    n = len(pairs)
+    g1 = (ctypes.c_uint64 * (12 * n))()
+    g2 = (ctypes.c_uint64 * (24 * n))()
+    for i, (p, q) in enumerate(pairs):
+        g1[i * 12:(i + 1) * 12] = _limbs(p[0]) + _limbs(p[1])
+        g2[i * 24:(i + 1) * 24] = (_limbs(q[0][0]) + _limbs(q[0][1]) +
+                                   _limbs(q[1][0]) + _limbs(q[1][1]))
+    return g1, g2
+
+
+def multi_pairing_is_one(pairs: Sequence[Tuple[tuple, tuple]]) -> bool:
+    """prod_i e(P_i, Q_i) == 1 for AFFINE non-infinity pairs (validated
+    upstream — the python seam filters identities before calling)."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    g1, g2 = _pack(pairs)
+    return bool(lib.bls381_multi_pairing_is_one(g1, g2, len(pairs)))
+
+
+def multi_pairing_gt(pairs: Sequence[Tuple[tuple, tuple]]) -> tuple:
+    """The CUBED GT value (matches ``pairing.final_exponentiation_cubed``
+    of the Miller product) — oracle cross-checks in tests."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    g1, g2 = _pack(pairs)
+    out = (ctypes.c_uint64 * 144)()
+    lib.bls381_multi_pairing_gt(g1, g2, len(pairs), out)
+    f = [sum(int(out[i * 6 + j]) << (64 * j) for j in range(6))
+         for i in range(12)]
+    return (((f[0], f[1]), (f[2], f[3]), (f[4], f[5])),
+            ((f[6], f[7]), (f[8], f[9]), (f[10], f[11])))

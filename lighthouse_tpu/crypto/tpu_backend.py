@@ -186,8 +186,11 @@ class _DevicePubkeyTable:
             key=lambda kv: (self._last_used.get(kv[0], 0), kv[1]),
             reverse=True)[:keep]
         survivors.sort(key=lambda kv: kv[1])  # stable column order
-        host = np.zeros((64, self._host.shape[1]), np.uint32)
         cols = [old for _, old in survivors]
+        cap = self._initial  # shrink to next pow2 >= survivors (+ col 0)
+        while cap < len(cols) + 1:
+            cap *= 2
+        host = np.zeros((64, cap), np.uint32)
         host[:, 1:len(cols) + 1] = self._host[:, cols]  # one gather
         index = {pt: i + 1 for i, (pt, _) in enumerate(survivors)}
         self._host, self._index, self._n = host, index, len(cols) + 1
@@ -422,6 +425,32 @@ def _dispatch(entries, rand_fn) -> bool:
     return bool(ok)
 
 
+def _host_fastpath_max() -> int:
+    """Batch sizes up to this verify on the HOST via the native C++
+    pairing instead of the device (VERDICT r4 #4): the axon tunnel adds
+    ~100 ms fixed roundtrip per device sync, while the native host verify
+    costs ~30 ms/set — so tiny batches (the gossip-block proposer check)
+    are latency-bound on dispatch, not compute.  Default crossover 4;
+    co-located deployments (µs dispatch) should set
+    LIGHTHOUSE_TPU_HOST_FASTPATH_MAX=0 to keep everything on-device."""
+    import os
+    try:
+        return int(os.environ.get("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX", "4"))
+    except ValueError:
+        return 4
+
+
+def _host_fast(n_sets: int) -> bool:
+    import os
+    if os.environ.get("LIGHTHOUSE_TPU_NO_NATIVE"):
+        return False  # kill-switch restores the device path entirely
+    if n_sets > _host_fastpath_max():
+        return False
+    from . import native
+    native.prebuild_async()  # no-op once built
+    return native.available(block=False)
+
+
 class TpuBackend:
     """Device-batched verification registered as ``tpu`` in :mod:`.bls`."""
 
@@ -430,6 +459,9 @@ class TpuBackend:
     def verify(self, signature, pubkeys, message) -> bool:
         if signature.point is None or not pubkeys:
             return False
+        if _host_fast(1):
+            from .bls import _BACKENDS
+            return _BACKENDS["python"].verify(signature, pubkeys, message)
         return _dispatch(
             [(signature.point, [k.point for k in pubkeys], bytes(message))],
             rand_fn=lambda: 1)
@@ -438,6 +470,10 @@ class TpuBackend:
         if signature.point is None or not pubkeys \
                 or len(pubkeys) != len(messages):
             return False
+        if _host_fast(len(messages)):
+            from .bls import _BACKENDS
+            return _BACKENDS["python"].aggregate_verify(
+                signature, pubkeys, messages)
         # Distinct message per signer: one single-key set per message, the
         # aggregate signature attached to the first set, scalars all 1.
         entries = [(None, [pk.point], bytes(m))
@@ -449,6 +485,9 @@ class TpuBackend:
         import secrets
         if not sets:
             return False
+        if _host_fast(len(sets)):
+            from .bls import _BACKENDS
+            return _BACKENDS["python"].verify_signature_sets(sets)
         entries = []
         for s in sets:
             if s.signature is None or s.signature.point is None:

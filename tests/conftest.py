@@ -99,3 +99,22 @@ def pytest_runtest_teardown(item):
         signal.alarm(0)
     except ValueError:
         pass
+
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def pin_device_path():
+    """Device-semantics test modules opt in via
+    ``pytestmark = pytest.mark.usefixtures("pin_device_path")``: disables
+    the native host fast path so small batches don't silently route to
+    the python backend (tpu_backend._host_fastpath_max)."""
+    import os
+    old = os.environ.get("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX")
+    os.environ["LIGHTHOUSE_TPU_HOST_FASTPATH_MAX"] = "0"
+    yield
+    if old is None:
+        os.environ.pop("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX", None)
+    else:
+        os.environ["LIGHTHOUSE_TPU_HOST_FASTPATH_MAX"] = old

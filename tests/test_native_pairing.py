@@ -1,0 +1,100 @@
+"""Native C++ multi-pairing (``native/bls381.cpp``) vs the python oracle.
+
+The native library is the host latency tier of BLS verification
+(``tpu_backend._host_fastpath_max``); these tests pin it bit-exactly to
+the RFC-anchored python pairing: the exported GT value (cubed final exp)
+must equal ``final_exponentiation_cubed(prod miller_loop)`` coefficient
+for coefficient, which transitively validates the Montgomery field core,
+the tower, the Miller loop, the sparse line mul, and the Granger–Scott
+cyclotomic squaring.
+"""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto import curve as C
+from lighthouse_tpu.crypto import fields as F
+from lighthouse_tpu.crypto import native
+from lighthouse_tpu.crypto import pairing as PR
+from lighthouse_tpu.crypto.hash_to_curve import hash_to_g2
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+random.seed(0xBEE5)
+
+
+def _rand_pairs(n):
+    pairs = []
+    for _ in range(n):
+        p = C.g1_mul(C.G1_GEN, random.randrange(1, F.R))
+        q = C.g2_mul(C.G2_GEN, random.randrange(1, F.R))
+        pairs.append((p, q))
+    return pairs
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_gt_matches_python_oracle(n):
+    pairs = _rand_pairs(n)
+    acc = F.FQ12_ONE
+    for p, q in pairs:
+        acc = F.fq12_mul(acc, PR.miller_loop(p, q))
+    assert native.multi_pairing_gt(pairs) == \
+        PR.final_exponentiation_cubed(acc)
+
+
+def test_is_one_verify_and_tamper():
+    sk = bls.SecretKey(987654321)
+    pk = sk.public_key()
+    sig = sk.sign(b"native check")
+    good = [(C.g1_neg(C.G1_GEN), sig.point),
+            (pk.point, hash_to_g2(b"native check"))]
+    bad = [(C.g1_neg(C.G1_GEN), sig.point),
+           (pk.point, hash_to_g2(b"tampered"))]
+    assert native.multi_pairing_is_one(good)
+    assert not native.multi_pairing_is_one(bad)
+
+
+def test_bilinearity_through_native():
+    # e(aP, bQ) * e(-abP, Q) == 1
+    a = random.randrange(1, 2**64)
+    b = random.randrange(1, 2**64)
+    P1 = C.g1_mul(C.G1_GEN, a)
+    Q1 = C.g2_mul(C.G2_GEN, b)
+    P2 = C.g1_neg(C.g1_mul(C.G1_GEN, a * b % F.R))
+    assert native.multi_pairing_is_one([(P1, Q1), (P2, C.G2_GEN)])
+    assert not native.multi_pairing_is_one([(P1, Q1), (P2, Q1)])
+
+
+def test_python_backend_native_and_pure_agree(monkeypatch):
+    sk, sk2 = bls.SecretKey(31337), bls.SecretKey(31338)
+    pk = sk.public_key()
+    sig = sk.sign(b"m")
+    sets = [bls.SignatureSet(sig, [pk], b"m"),
+            bls.SignatureSet(sk2.sign(b"n"), [sk2.public_key()], b"n")]
+    backend = bls._BACKENDS["python"]
+    native_results = (backend.verify(sig, [pk], b"m"),
+                      backend.verify(sig, [pk], b"x"),
+                      backend.verify_signature_sets(sets))
+    monkeypatch.setenv("LIGHTHOUSE_TPU_NO_NATIVE", "1")
+    pure_results = (backend.verify(sig, [pk], b"m"),
+                    backend.verify(sig, [pk], b"x"),
+                    backend.verify_signature_sets(sets))
+    assert native_results == pure_results == (True, False, True)
+
+
+def test_tpu_backend_host_fastpath_small_batch():
+    """On small batches the tpu backend routes to the native host path
+    (VERDICT r4 #4) — correct results, no device roundtrip."""
+    from lighthouse_tpu.crypto import tpu_backend  # noqa: F401 (registers)
+    tpu = bls._BACKENDS["tpu"]
+    sk = bls.SecretKey(777)
+    pk = sk.public_key()
+    sig = sk.sign(b"gossip block")
+    assert tpu_backend._host_fast(1)
+    assert tpu.verify(sig, [pk], b"gossip block")
+    assert not tpu.verify(sig, [pk], b"other")
+    sets = [bls.SignatureSet(sig, [pk], b"gossip block")]
+    assert tpu.verify_signature_sets(sets)

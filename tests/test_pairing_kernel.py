@@ -11,6 +11,11 @@ import numpy as np
 import pytest
 import jax
 
+
+import pytest as _pytest
+
+pytestmark = _pytest.mark.usefixtures("pin_device_path")
+
 pytestmark = pytest.mark.skipif(
     jax.default_backend() != "tpu",
     reason="pallas pairing kernels need a real TPU (Mosaic)")
