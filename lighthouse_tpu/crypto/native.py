@@ -93,6 +93,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.bls381_g1_aggregate.restype = ctypes.c_int
+        lib.bls381_g1_aggregate.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
 
@@ -141,6 +145,24 @@ def multi_pairing_is_one(pairs: Sequence[Tuple[tuple, tuple]]) -> bool:
     assert lib is not None, "call available() first"
     g1, g2 = _pack(pairs)
     return bool(lib.bls381_multi_pairing_is_one(g1, g2, len(pairs)))
+
+
+def g1_aggregate(points: Sequence[tuple]) -> Optional[tuple]:
+    """Affine sum of non-infinity G1 points (None = identity sum) —
+    the jacobian accumulation behind ``bls.aggregate_public_keys`` and
+    the shared-keygroup dedup (~5 µs/point vs ~500 µs python)."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    n = len(points)
+    buf = (ctypes.c_uint64 * (12 * n))()
+    for i, (x, y) in enumerate(points):
+        buf[i * 12:(i + 1) * 12] = _limbs(x) + _limbs(y)
+    out = (ctypes.c_uint64 * 12)()
+    if not lib.bls381_g1_aggregate(buf, n, out):
+        return None
+    x = sum(int(out[j]) << (64 * j) for j in range(6))
+    y = sum(int(out[6 + j]) << (64 * j) for j in range(6))
+    return (x, y)
 
 
 def multi_pairing_gt(pairs: Sequence[Tuple[tuple, tuple]]) -> tuple:

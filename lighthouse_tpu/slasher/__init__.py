@@ -136,7 +136,9 @@ class Slasher:
         if t > s + 1:
             es = np.arange(s + 1, t)
             cols = es % self.history
-            vals = (t - es).astype(np.uint16)
+            # Saturate at the u16 bound (reference MAX_SPAN encoding) —
+            # an unclamped cast would wrap for adversarial t − s > 65535.
+            vals = np.minimum(t - es, 0xFFFE).astype(np.uint16)
             plane = self.max_span[live[:, None], cols[None, :]]
             self.max_span[live[:, None], cols[None, :]] = \
                 np.maximum(plane, vals[None, :])
@@ -255,9 +257,17 @@ def bench_span_update(n_validators: int = 1 << 20, n_atts: int = 1024,
     if slashings:
         raise RuntimeError("collision-free schedule produced slashings")
 
-    return {
+    out = {
         "slasher_update_1m_ms": round(numpy_ms, 1),
         "slasher_atts": n_atts,
         "slasher_attesters_per_att": per_att,
         "slasher_history": history,
     }
+    del slasher  # free the numpy planes before the device allocation
+    try:
+        from .device_spans import bench_device_span_update
+        out.update(bench_device_span_update(
+            n_validators=n_validators, history=history, atts=atts))
+    except Exception as e:  # device column must not lose the numpy row
+        out["slasher_device_error"] = f"{type(e).__name__}: {e}"
+    return out

@@ -695,6 +695,82 @@ static void final_exp_cubed(Fp12 &r, const Fp12 &f) {
 }
 
 // --------------------------------------------------------------------------
+// G1 aggregation (jacobian): pubkey sums for fast_aggregate_verify and
+// the shared-keygroup dedup in the tpu backend's batch marshalling.
+// --------------------------------------------------------------------------
+
+struct G1Jac { Fp X, Y, Z; };  // x = X/Z², y = Y/Z³; infinity: Z = 0
+
+static void g1j_dbl(G1Jac &r, const G1Jac &p) {
+    if (fp_is_zero(p.Z)) { r = p; return; }
+    Fp A, B, Cc, D, t;
+    fp_sqr(A, p.X);                 // X²
+    fp_sqr(B, p.Y);                 // Y²
+    fp_sqr(Cc, B);                  // Y⁴
+    fp_add(t, p.X, B);
+    fp_sqr(t, t);
+    fp_sub(t, t, A);
+    fp_sub(t, t, Cc);
+    fp_dbl(D, t);                   // D = 2((X+Y²)² − X² − Y⁴)
+    Fp E;
+    fp_dbl(E, A); fp_add(E, E, A);  // 3X²
+    Fp F2;
+    fp_sqr(F2, E);
+    Fp X3;
+    fp_sub(X3, F2, D);
+    fp_sub(X3, X3, D);              // E² − 2D
+    Fp Y3;
+    fp_sub(t, D, X3);
+    fp_mul(Y3, E, t);
+    Fp c8;
+    fp_dbl(c8, Cc); fp_dbl(c8, c8); fp_dbl(c8, c8);  // 8Y⁴
+    fp_sub(Y3, Y3, c8);
+    Fp Z3;
+    fp_mul(t, p.Y, p.Z);
+    fp_dbl(Z3, t);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+}
+
+// Mixed add: q affine (never infinity — callers filter).
+static void g1j_add_aff(G1Jac &r, const G1Jac &p, const Fp &qx,
+                        const Fp &qy) {
+    if (fp_is_zero(p.Z)) {
+        r.X = qx; r.Y = qy; r.Z = *fp_one();
+        return;
+    }
+    Fp Z2, U2, S2, H, Rr, t;
+    fp_sqr(Z2, p.Z);
+    fp_mul(U2, qx, Z2);
+    fp_mul(t, qy, Z2);
+    fp_mul(S2, t, p.Z);
+    fp_sub(H, U2, p.X);
+    fp_sub(Rr, S2, p.Y);
+    if (fp_is_zero(H)) {
+        if (fp_is_zero(Rr)) { g1j_dbl(r, p); return; }
+        r.X = *fp_one(); r.Y = *fp_one();  // P + (−P) = O (Z = 0)
+        fp_zero(r.Z);
+        return;
+    }
+    Fp HH, HHH, V;
+    fp_sqr(HH, H);
+    fp_mul(HHH, HH, H);
+    fp_mul(V, p.X, HH);
+    Fp X3;
+    fp_sqr(X3, Rr);
+    fp_sub(X3, X3, HHH);
+    fp_sub(X3, X3, V);
+    fp_sub(X3, X3, V);
+    Fp Y3;
+    fp_sub(t, V, X3);
+    fp_mul(Y3, Rr, t);
+    fp_mul(t, p.Y, HHH);
+    fp_sub(Y3, Y3, t);
+    Fp Z3;
+    fp_mul(Z3, p.Z, H);
+    r.X = X3; r.Y = Y3; r.Z = Z3;
+}
+
+// --------------------------------------------------------------------------
 // C API
 // --------------------------------------------------------------------------
 
@@ -756,6 +832,35 @@ void bls381_multi_pairing_gt(const uint64_t *g1, const uint64_t *g2,
         fp_mul(s, coeffs[i], one_std);
         std::memcpy(out + i * 6, s.l, 48);
     }
+}
+
+// Sum n affine G1 points (12 u64 each, standard form, non-infinity —
+// callers filter identities).  Writes the affine sum to out[12]; returns
+// 1 on a finite sum, 0 if the sum is the identity (out untouched).
+int bls381_g1_aggregate(const uint64_t *pts, uint64_t n, uint64_t *out) {
+    G1Jac acc;
+    fp_zero(acc.X); fp_zero(acc.Y); fp_zero(acc.Z);
+    for (uint64_t i = 0; i < n; i++) {
+        Fp qx, qy;
+        fp_from_limbs(qx, pts + i * 12);
+        fp_from_limbs(qy, pts + i * 12 + 6);
+        g1j_add_aff(acc, acc, qx, qy);
+    }
+    if (fp_is_zero(acc.Z)) return 0;
+    // to affine: x = X/Z², y = Y/Z³; then Montgomery -> standard.
+    Fp zi, zi2, zi3, ax, ay, one_std;
+    fp_inv(zi, acc.Z);
+    fp_sqr(zi2, zi);
+    fp_mul(zi3, zi2, zi);
+    fp_mul(ax, acc.X, zi2);
+    fp_mul(ay, acc.Y, zi3);
+    std::memset(&one_std, 0, sizeof one_std);
+    one_std.l[0] = 1;
+    fp_mul(ax, ax, one_std);
+    fp_mul(ay, ay, one_std);
+    std::memcpy(out, ax.l, 48);
+    std::memcpy(out + 6, ay.l, 48);
+    return 1;
 }
 
 }  // extern "C"

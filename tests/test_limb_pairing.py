@@ -23,10 +23,7 @@ from lighthouse_tpu.crypto import limb_pairing as LP
 from lighthouse_tpu.crypto import limb_tower as T
 from lighthouse_tpu.crypto import pairing as HP
 
-
-import pytest as _pytest
-
-pytestmark = _pytest.mark.usefixtures("pin_device_path")
+pytestmark = pytest.mark.usefixtures("pin_device_path")
 
 slow = pytest.mark.skipif(not os.environ.get("LTPU_SLOW"),
                           reason="set LTPU_SLOW=1 (scan compiles are minutes cold)")

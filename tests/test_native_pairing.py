@@ -98,3 +98,54 @@ def test_tpu_backend_host_fastpath_small_batch():
     assert not tpu.verify(sig, [pk], b"other")
     sets = [bls.SignatureSet(sig, [pk], b"gossip block")]
     assert tpu.verify_signature_sets(sets)
+
+
+def test_g1_aggregate_matches_python_fold():
+    pks = [bls.SecretKey(4000 + i).public_key() for i in range(48)]
+    acc = None
+    for k in pks:
+        acc = C.g1_add(acc, k.point)
+    assert native.g1_aggregate([k.point for k in pks]) == acc
+    # identity sum
+    p = pks[0].point
+    assert native.g1_aggregate([p, C.g1_neg(p)]) is None
+    # single point is itself
+    assert native.g1_aggregate([p]) == p
+
+
+def test_aggregate_public_keys_native_and_pure_agree(monkeypatch):
+    pks = [bls.SecretKey(4100 + i).public_key() for i in range(32)]
+    a = bls.aggregate_public_keys(pks)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_NO_NATIVE", "1")
+    b = bls.aggregate_public_keys(pks)
+    assert a == b
+
+
+def test_dedup_shared_keygroups():
+    """fast_aggregate_verify shape: sets sharing one pubkey list collapse
+    to a single aggregated key; mixed batches keep distinct lists."""
+    from lighthouse_tpu.crypto import tpu_backend as TB
+    pks = [bls.SecretKey(4200 + i).public_key() for i in range(16)]
+    shared = [k.point for k in pks]
+    solo = [pks[0].point]
+    entries = [(None, list(shared), b"m%d" % i) for i in range(4)]
+    entries.append((None, list(solo), b"solo"))
+    out, valid = TB._dedup_shared_keygroups(entries)
+    assert valid
+    agg = bls.aggregate_public_keys(pks)
+    assert [e[1] for e in out[:4]] == [[agg]] * 4
+    assert out[4][1] == solo
+    # an infinity aggregate marks the batch invalid
+    cancel = [pks[0].point, C.g1_neg(pks[0].point), pks[1].point,
+              C.g1_neg(pks[1].point), pks[2].point]
+    ent2 = [(None, list(cancel), b"a"), (None, list(cancel), b"b")]
+    # identical 5-key lists shared by 2 sets -> aggregated; sum is NOT
+    # infinity here (pks[2] survives), so stays valid
+    out2, valid2 = TB._dedup_shared_keygroups(ent2)
+    assert valid2 and out2[0][1] == [pks[2].point]
+    full_cancel = [pks[0].point, C.g1_neg(pks[0].point), pks[1].point,
+                   C.g1_neg(pks[1].point), pks[2].point,
+                   C.g1_neg(pks[2].point)]
+    ent3 = [(None, list(full_cancel), b"a"), (None, list(full_cancel), b"b")]
+    _, valid3 = TB._dedup_shared_keygroups(ent3)
+    assert not valid3

@@ -12,13 +12,12 @@ import pytest
 import jax
 
 
-import pytest as _pytest
-
-pytestmark = _pytest.mark.usefixtures("pin_device_path")
-
-pytestmark = pytest.mark.skipif(
-    jax.default_backend() != "tpu",
-    reason="pallas pairing kernels need a real TPU (Mosaic)")
+pytestmark = [
+    pytest.mark.usefixtures("pin_device_path"),
+    pytest.mark.skipif(
+        jax.default_backend() != "tpu",
+        reason="pallas pairing kernels need a real TPU (Mosaic)"),
+]
 
 
 def _g1_planes(pts, M):

@@ -102,3 +102,71 @@ def test_proposer_double_proposal():
     assert s.accept_block_header(s1) is None  # identical: benign
     out = s.accept_block_header(s2)
     assert out is not None and out.kind == "double_proposal"
+
+
+def test_device_span_plane_matches_host():
+    """The fused device ingest (device_spans) must reproduce the host
+    Slasher's numpy span planes exactly, including ring wraparound and
+    the pre-update source-column gathers used for surround detection."""
+    import numpy as np
+    from lighthouse_tpu.slasher import Slasher
+    from lighthouse_tpu.slasher.device_spans import DeviceSpanPlane
+
+    rng = np.random.default_rng(7)
+    n, H = 256, 64
+    host = Slasher(n, history_length=H)
+    dev = DeviceSpanPlane(n, history=H)
+
+    triples = []
+    for i in range(20):
+        t = int(rng.integers(40, 120))         # exercises e % H wraps
+        s = max(0, t - int(rng.integers(1, 50)))
+        idx = rng.choice(n, int(rng.integers(1, 30)), replace=False)
+        triples.append((s, t, idx))
+
+    # Host: drive the span sweeps directly (same order as the groups).
+    groups = DeviceSpanPlane.group(triples)
+    for s, t, idx in groups:
+        lo = max(s - H + 1, 0)
+        if s > lo:
+            es = np.arange(lo, s)
+            cols = es % H
+            vals = np.minimum(t - es, 0xFFFE).astype(np.uint16)
+            cur = host.min_span[idx[:, None], cols[None, :]]
+            host.min_span[idx[:, None], cols[None, :]] = \
+                np.minimum(cur, vals[None, :])
+        if t > s + 1:
+            es = np.arange(s + 1, t)
+            cols = es % H
+            vals = (t - es).astype(np.uint16)
+            cur = host.max_span[idx[:, None], cols[None, :]]
+            host.max_span[idx[:, None], cols[None, :]] = \
+                np.maximum(cur, vals[None, :])
+
+    pre = dev.ingest(groups)
+    mn, mx = dev.to_host()
+    assert (mn == host.min_span).all()
+    assert (mx == host.max_span).all()
+    # pre-update gathers exist for every group and have plane width
+    assert set(pre) == {(s, t) for s, t, _ in groups}
+    for (s, t), (gmin, gmax) in pre.items():
+        assert gmin.shape == (n,) and gmax.shape == (n,)
+
+
+def test_device_span_gathers_enable_surround_detection():
+    """The (pre-update) source-column gathers reproduce the host's
+    surround predicates: max_span[v][s] > t−s / min_span[v][s] < t−s."""
+    import numpy as np
+    from lighthouse_tpu.slasher.device_spans import DeviceSpanPlane
+
+    n, H = 64, 32
+    dev = DeviceSpanPlane(n, history=H)
+    # att A: validator 5, (s=2, t=10) — writes max_span cols for e in (2,10)
+    dev.ingest(dev.group([(2, 10, np.array([5]))]))
+    # att B: validator 5, (s=4, t=6): A surrounds B
+    pre = dev.ingest(dev.group([(4, 6, np.array([5]))]))
+    gmin, gmax = pre[(4, 6)]
+    dist = 6 - 4
+    assert int(gmax[5]) > dist          # surrounded by A
+    # a fresh validator shows no surround
+    assert int(gmax[6]) == 0
