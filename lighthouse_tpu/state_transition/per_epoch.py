@@ -193,10 +193,12 @@ def inactivity_penalty_quotient(fork: ForkName, preset) -> int:
     return preset.INACTIVITY_PENALTY_QUOTIENT
 
 
-def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
-                                  summary: EpochSummary) -> None:
-    if current_epoch(state, preset) == GENESIS_EPOCH:
-        return
+def flag_deltas(state, fork: ForkName, preset, spec):
+    """Per-component deltas — the EF `rewards` runner's decomposition of
+    altair+ `get_flag_index_deltas` + `get_inactivity_penalty_deltas`
+    (`altair/rewards_and_penalties.rs`): component name → (rewards,
+    penalties) uint64 arrays for source / target / head /
+    inactivity_penalty."""
     n = len(state.validators)
     prev = previous_epoch(state, preset)
     total = get_total_active_balance(state, preset)
@@ -205,10 +207,11 @@ def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
     active_increments = total // preset.EFFECTIVE_BALANCE_INCREMENT
     in_leak = is_in_inactivity_leak(state, preset)
 
-    rewards = np.zeros(n, dtype=np.uint64)
-    penalties = np.zeros(n, dtype=np.uint64)
-
+    out = {}
+    names = ("source", "target", "head")
     for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        rewards = np.zeros(n, dtype=np.uint64)
+        penalties = np.zeros(n, dtype=np.uint64)
         participating = unslashed_participating_mask(
             state, flag_index, prev, preset)
         unslashed_increments = (
@@ -225,6 +228,7 @@ def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
                 eligible & ~participating,
                 base * np.uint64(weight) // np.uint64(WEIGHT_DENOMINATOR),
                 np.uint64(0))
+        out[names[flag_index]] = (rewards, penalties)
 
     # Inactivity penalties (altair formula).
     target = unslashed_participating_mask(
@@ -234,7 +238,23 @@ def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
                 * inactivity_penalty_quotient(fork, preset))
     inact = (state.validators.col("effective_balance") * scores
              // np.uint64(quotient))
-    penalties += np.where(eligible & ~target, inact, np.uint64(0))
+    out["inactivity_penalty"] = (
+        np.zeros(n, dtype=np.uint64),
+        np.where(eligible & ~target, inact, np.uint64(0)))
+    return out
+
+
+def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
+                                  summary: EpochSummary) -> None:
+    if current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    deltas = flag_deltas(state, fork, preset, spec)
+    rewards = np.zeros(n, dtype=np.uint64)
+    penalties = np.zeros(n, dtype=np.uint64)
+    for r, p in deltas.values():
+        rewards += r
+        penalties += p
 
     summary.rewards, summary.penalties = rewards, penalties
     bal = _full_column(state.balances, n, np.uint64)

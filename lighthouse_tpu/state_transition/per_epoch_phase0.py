@@ -105,14 +105,14 @@ def process_justification_and_finalization_phase0(
                                          preset, T)
 
 
-def process_rewards_and_penalties_phase0(state, preset, spec,
-                                         summary: EpochSummary) -> None:
-    """`get_attestation_deltas` (`base/rewards_and_penalties.rs`), as
-    column arithmetic over the participation masks."""
-    from ..types.chain_spec import GENESIS_EPOCH
-
-    if current_epoch(state, preset) == GENESIS_EPOCH:
-        return
+def attestation_deltas_phase0(state, preset, spec):
+    """Per-component attestation deltas — the EF `rewards` runner's
+    decomposition of `get_attestation_deltas`
+    (`base/rewards_and_penalties.rs`): a dict of component name →
+    (rewards, penalties) int64 arrays for source / target / head /
+    inclusion_delay / inactivity_penalty.  Applying the summed
+    components is exactly :func:`process_rewards_and_penalties_phase0`.
+    """
     n = len(state.validators)
     balances = np.asarray(state.validators.col("effective_balance"),
                           dtype=np.int64)
@@ -131,11 +131,13 @@ def process_rewards_and_penalties_phase0(state, preset, spec,
 
     incr = preset.EFFECTIVE_BALANCE_INCREMENT
     total_incr = total // incr
-    rewards = np.zeros(n, dtype=np.int64)
-    penalties = np.zeros(n, dtype=np.int64)
     in_leak = _in_leak(state, preset)
 
-    for mask in (src_mask, tgt_mask, head_mask):
+    out = {}
+    for name, mask in (("source", src_mask), ("target", tgt_mask),
+                       ("head", head_mask)):
+        rewards = np.zeros(n, dtype=np.int64)
+        penalties = np.zeros(n, dtype=np.int64)
         att_incr = int(balances[mask].sum()) // incr
         hit = eligible & mask
         miss = eligible & ~mask
@@ -145,15 +147,19 @@ def process_rewards_and_penalties_phase0(state, preset, spec,
         else:
             rewards[hit] += base_reward[hit] * att_incr // total_incr
         penalties[miss] += base_reward[miss]
+        out[name] = (rewards, penalties)
 
     # Inclusion delay: proposer cut + delay-decayed attester reward.
     proposer_reward = base_reward // preset.PROPOSER_REWARD_QUOTIENT
+    rewards = np.zeros(n, dtype=np.int64)
     src_idx = np.nonzero(src_mask)[0]
     for i in src_idx:
         rewards[min_prop[i]] += int(proposer_reward[i])
         max_att = int(base_reward[i]) - int(proposer_reward[i])
         rewards[i] += max_att // int(min_delay[i])
+    out["inclusion_delay"] = (rewards, np.zeros(n, dtype=np.int64))
 
+    penalties = np.zeros(n, dtype=np.int64)
     if in_leak:
         delay = _finality_delay(state, preset)
         el = np.nonzero(eligible)[0]
@@ -162,7 +168,25 @@ def process_rewards_and_penalties_phase0(state, preset, spec,
         lazy = eligible & ~tgt_mask
         penalties[lazy] += (balances[lazy] * delay
                             // preset.INACTIVITY_PENALTY_QUOTIENT)
+    out["inactivity_penalty"] = (np.zeros(n, dtype=np.int64), penalties)
+    return out
 
+
+def process_rewards_and_penalties_phase0(state, preset, spec,
+                                         summary: EpochSummary) -> None:
+    """`get_attestation_deltas` (`base/rewards_and_penalties.rs`), as
+    column arithmetic over the participation masks."""
+    from ..types.chain_spec import GENESIS_EPOCH
+
+    if current_epoch(state, preset) == GENESIS_EPOCH:
+        return
+    n = len(state.validators)
+    deltas = attestation_deltas_phase0(state, preset, spec)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    for r, p in deltas.values():
+        rewards += r
+        penalties += p
     bal = np.asarray(state.balances, dtype=np.int64)
     state.balances[:] = np.maximum(bal + rewards - penalties, 0).astype(
         np.uint64)

@@ -39,6 +39,7 @@ import numpy as np
 import yaml
 
 from ..crypto import bls as B
+from ..ssz import Container, List, uint64
 from ..state_transition import per_block as PB
 from ..state_transition import per_epoch as PE
 from ..state_transition import signature_sets as sigs
@@ -243,7 +244,7 @@ def _init_operations():
         e = ctx.T.SignedVoluntaryExit.deserialize(data)
         acc = PB.SigAccumulator(PB.SignatureStrategy.VERIFY_BULK)
         PB.process_voluntary_exit(state, e, ctx.fork, ctx.preset, ctx.spec,
-                                  acc, None)
+                                  acc, sigs.PubkeyCache())
         acc.finish()
 
     def deposit(ctx, state, data):
@@ -422,6 +423,84 @@ def _case_bls(ctx: CaseCtx, handler: str) -> None:
                             f"{out!r}")
 
 
+# -- transition (fork boundary) runner --------------------------------------
+
+_PRE_FORK = {ForkName.ALTAIR: ForkName.PHASE0,
+             ForkName.BELLATRIX: ForkName.ALTAIR,
+             ForkName.CAPELLA: ForkName.BELLATRIX}
+_FORK_EPOCH_ATTR = {ForkName.ALTAIR: "altair_fork_epoch",
+                    ForkName.BELLATRIX: "bellatrix_fork_epoch",
+                    ForkName.CAPELLA: "capella_fork_epoch"}
+
+
+def _case_transition(ctx: CaseCtx, handler: str) -> None:
+    """Fork-boundary transition (`testing/ef_tests/src/cases/
+    transition.rs`): the case's fork DIR names the POST fork; blocks span
+    the boundary, with `meta.yaml`'s `fork_block` the index of the last
+    pre-fork block."""
+    from dataclasses import replace
+
+    from ..state_transition.per_slot import state_transition
+
+    meta = ctx.yaml("meta.yaml")
+    post_fork = FORKS[meta["post_fork"]]
+    if post_fork != ctx.fork:
+        raise EfTestFailure(
+            f"{ctx.case_dir}: post_fork {meta['post_fork']} does not match "
+            f"the case's fork dir")
+    pre_fork = _PRE_FORK[post_fork]
+    fork_epoch = int(meta["fork_epoch"])
+    spec = replace(
+        (ChainSpec.minimal() if ctx.config == "minimal"
+         else ChainSpec.mainnet()).with_forks_at_genesis(pre_fork),
+        **{_FORK_EPOCH_ATTR[post_fork]: fork_epoch})
+    fork_block = int(meta.get("fork_block", -1))
+    state = ctx.T.state_cls(pre_fork).deserialize(ctx.ssz("pre.ssz"))
+    for i in range(int(meta["blocks_count"])):
+        raw = ctx.ssz(f"blocks_{i}.ssz")
+        blk_fork = pre_fork if i <= fork_block else post_fork
+        sb = ctx.T.signed_block_cls(blk_fork).deserialize(raw)
+        state = state_transition(state, sb, ctx.preset, spec, ctx.T,
+                                 strategy=PB.SignatureStrategy.VERIFY_BULK)
+    ctx.expect_post(state)
+
+
+# -- rewards runner ----------------------------------------------------------
+
+class Deltas(Container):
+    """EF rewards-runner component deltas (`cases/rewards.rs` Deltas)."""
+    rewards: List(uint64, 1 << 40)
+    penalties: List(uint64, 1 << 40)
+
+
+def _case_rewards(ctx: CaseCtx, handler: str) -> None:
+    """EF rewards runner (`cases/rewards.rs`): per-component attestation
+    deltas compared against the committed Deltas SSZ files."""
+    from ..state_transition.per_epoch import flag_deltas
+    from ..state_transition.per_epoch_phase0 import attestation_deltas_phase0
+
+    state = ctx.state("pre")
+    if ctx.fork == ForkName.PHASE0:
+        deltas = attestation_deltas_phase0(state, ctx.preset, ctx.spec)
+        components = ("source", "target", "head", "inclusion_delay",
+                      "inactivity_penalty")
+    else:
+        deltas = flag_deltas(state, ctx.fork, ctx.preset, ctx.spec)
+        components = ("source", "target", "head", "inactivity_penalty")
+    for name in components:
+        raw = ctx.ssz(f"{name}_deltas.ssz")
+        if raw is None:
+            raise EfTestFailure(f"{ctx.case_dir}: missing {name}_deltas.ssz")
+        want = Deltas.deserialize(raw)
+        r, p = deltas[name]
+        got_r = [int(x) for x in r]
+        got_p = [int(x) for x in p]
+        if got_r != [int(x) for x in want.rewards] or \
+                got_p != [int(x) for x in want.penalties]:
+            raise EfTestFailure(
+                f"{ctx.case_dir}: {name} deltas mismatch")
+
+
 _RUNNERS: Dict[str, Callable] = {
     "ssz_static": _case_ssz_static,
     "shuffling": _case_shuffling,
@@ -429,6 +508,8 @@ _RUNNERS: Dict[str, Callable] = {
     "operations": _case_operations,
     "epoch_processing": _case_epoch_processing,
     "bls": _case_bls,
+    "transition": _case_transition,
+    "rewards": _case_rewards,
 }
 
 
