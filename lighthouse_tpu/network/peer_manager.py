@@ -99,6 +99,20 @@ class PeerManager:
         live = [p for p in peers if not self.is_banned(p)]
         return sorted(live, key=lambda p: -self.score(p))
 
+    def identify(self, peer, node_id: bytes) -> None:
+        """Attach a stable node id to a peer, MIGRATING any score already
+        accumulated under its handle identity — without this, a spammer
+        banned pre-handshake could un-ban itself by sending one Status
+        (the fresh id would key a fresh zero score).  When both entries
+        exist the WORSE score wins: identities cannot launder scores."""
+        old = self._info.pop(id(peer), None)
+        peer.peer_id = node_id
+        if old is None:
+            return
+        cur = self._info.get(node_id)
+        if cur is None or old.current_score() < cur.current_score():
+            self._info[node_id] = old
+
     def forget(self, peer) -> None:
         """Disconnect housekeeping: drop UNKEYED (handle-identity) entries
         so churn cannot leak; identified peers keep their score so a ban

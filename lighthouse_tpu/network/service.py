@@ -253,36 +253,11 @@ class NetworkNode:
         return False
 
     def _range_sync(self, target_slot: int) -> bool:
-        """`range_sync`: pull the missing span from the best-scored peer
-        ahead of us and import as a chain segment; peers that time out or
-        serve garbage are penalized and (eventually) banned."""
-        from .peer_manager import PeerAction
-        start = self.chain.head.slot + 1
-        for peer in self.peer_manager.best_peers(self.peers):
-            try:
-                if peer.head_slot() < start:
-                    continue
-                blocks = peer.blocks_by_range(BlocksByRangeRequest(
-                    start_slot=start, count=max(target_slot - start + 1, 1)))
-            except Exception as e:
-                # A stalled/dead wire peer (Req/Resp timeout, reset socket)
-                # must not abort the sync loop — penalize and try the next
-                # peer (`range_sync` peer scoring/rotation).
-                self.peer_manager.report(peer, PeerAction.TIMEOUT)
-                self.log.warn("range-sync peer failed", peer=str(peer),
-                              reason=type(e).__name__)
-                continue
-            ok = False
-            for b in blocks:
-                try:
-                    self.chain.per_slot_task(int(b.message.slot))
-                    self.chain.process_block(b)
-                    ok = True
-                except BlockError:
-                    pass
-            if ok:
-                self.peer_manager.report(peer, PeerAction.SYNC_SERVED)
-                return True
-            elif blocks:
-                self.peer_manager.report(peer, PeerAction.INVALID_MESSAGE)
-        return False
+        """`range_sync`: epoch-aligned batch state machine with per-batch
+        peer rotation and retries (:mod:`.range_sync` — `SyncingChain` /
+        `BatchInfo` / finalized-vs-head split)."""
+        from .range_sync import RangeSync
+        rs = getattr(self, "_rs", None)
+        if rs is None:
+            rs = self._rs = RangeSync(self)
+        return rs.sync_to(target_slot)

@@ -28,3 +28,59 @@ def test_three_node_network_finalizes():
             assert n.chain.fork_choice.finalized_checkpoint[0] >= 2
     finally:
         sim.close()
+
+
+@pytest.mark.timeout(300)
+def test_network_with_hostile_peers_finalizes():
+    """VERDICT r4 #6 'done' criterion: a network with one spamming and
+    one stalling peer still finalizes, and the spammer ends banned."""
+    import socket
+    import struct
+    import threading
+    import time
+
+    sim = Simulator(n_nodes=4, n_validators=16)
+    try:
+        assert sim.wait_for_mesh()
+        target = sim.nodes[0].net
+
+        # Spammer: valid framing, junk topics/bodies, high rate.
+        spam = socket.create_connection(("127.0.0.1", target.port))
+
+        def spam_loop():
+            junk = b"\x07garbage" + b"\xff" * 64  # topic 'garbage'
+            frame = struct.pack("<BI", 0, len(junk)) + junk
+            try:
+                for _ in range(300):
+                    spam.sendall(frame * 4)
+                    time.sleep(0.01)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=spam_loop, daemon=True)
+        t.start()
+
+        # Staller: connects and never reads nor responds.
+        stall = socket.create_connection(("127.0.0.1", sim.nodes[1].net.port))
+
+        sim.run(32)
+        assert len(sim.heads()) == 1
+        assert min(sim.finalized_epochs()) >= 2
+
+        # The spammer's peer entry is banned at the target node.
+        pm = target.node.peer_manager
+        banned = [info for info in pm._info.values()
+                  if info.current_score() <= -60.0]
+        assert banned, "spammer was not banned"
+        # ...and pruned from every gossip mesh.
+        with target._lock:
+            spam_conns = [c for c in target._conns
+                          for p in [target._peers.get(c)]
+                          if p is not None and pm.is_banned(p)]
+            for mesh in target._mesh.values():
+                for c in spam_conns:
+                    assert c not in mesh
+        stall.close()
+        spam.close()
+    finally:
+        sim.close()
