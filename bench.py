@@ -341,6 +341,14 @@ def main() -> None:
     from __graft_entry__ import _enable_compile_cache
     _enable_compile_cache()
 
+    # Per-row hang watchdog: the axon tunnel can wedge inside a device
+    # call with no Python-level timeout possible; if a row exceeds its
+    # budget, dump every stack and HARD-EXIT — the rows already printed
+    # are still captured by the driver (the whole point of incremental
+    # emission).  Cold compiles legitimately run ~35 min, hence the
+    # generous default.
+    row_timeout = float(os.environ.get("BENCH_ROW_TIMEOUT_S", "2700"))
+
     merged: dict = {}
     skipped: list = []
     for name, fn, metric in _ROWS:
@@ -351,6 +359,8 @@ def main() -> None:
                    "elapsed_s": round(elapsed, 1)})
             continue
         t0 = time.monotonic()
+        faulthandler.dump_traceback_later(row_timeout, exit=True,
+                                          file=sys.stderr)
         try:
             row = fn()
         except Exception as e:  # one bad row must not kill the run
@@ -359,6 +369,7 @@ def main() -> None:
             merged[f"{name}_error"] = f"{type(e).__name__}: {e}"
             continue
         finally:
+            faulthandler.cancel_dump_traceback_later()
             import gc
             gc.collect()  # free each row's arrays before the next one
         merged.update(row)

@@ -120,6 +120,11 @@ class BeaconChain:
         self._states_by_block: dict[bytes, object] = {
             genesis_block_root: genesis_state.copy()}
         self._advanced_states: dict = {}
+        from .attester_cache import (
+            AttesterCache, BlockTimesCache, EarlyAttesterCache)
+        self.attester_cache = AttesterCache()
+        self.early_attester_cache = EarlyAttesterCache()
+        self.block_times_cache = BlockTimesCache()
         self.head = CanonicalHead(root=genesis_block_root,
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
@@ -247,15 +252,77 @@ class BeaconChain:
         # the hot path.  Epoch boundaries are exactly where the advance is
         # expensive AND where the shuffling changes, so warming it here
         # moves that cost off the gossip deadline.
-        key = (self.head.root, slot)
-        if slot > self.head.slot and key not in self._advanced_states:
-            try:
-                advanced = process_slots(self.head.state.copy(), slot,
-                                         self.preset, self.spec, self.T)
-            except Exception:
-                return  # advance failure must never kill the timer tick
-            self._bound_advanced_states()
-            self._advanced_states[key] = advanced
+        if slot > self.head.slot:
+            self._advance_and_prime(slot)
+
+    def _advance_and_prime(self, target_slot: int) -> None:
+        """Pre-advance the head state to ``target_slot`` (memoised) and
+        prime the attester cache for its epoch while the state is hot."""
+        key = (self.head.root, target_slot)
+        if key in self._advanced_states:
+            return
+        try:
+            advanced = process_slots(self.head.state.copy(), target_slot,
+                                     self.preset, self.spec, self.T)
+        except Exception:
+            return  # advance failure must never kill the timer tick
+        self._bound_advanced_states()
+        self._advanced_states[key] = advanced
+        self.attester_cache.prime_from_state(self.head.root, advanced,
+                                             self.preset)
+
+    def on_three_quarters_slot(self, slot: int) -> None:
+        """`state_advance_timer.rs:94-106`: at 3/4 of slot N, pre-advance
+        the head state to N+1 and prime the attester cache, so the FIRST
+        attestation/block work of N+1 finds committees, source, and
+        target without touching a state.  Called by the real-time node's
+        slot loop (`cli.py` beacon-node) and the simulator's slot driver;
+        tests call it explicitly."""
+        if slot + 1 > self.head.slot:
+            self._advance_and_prime(slot + 1)
+
+    def attestation_data_parts(self, slot: int):
+        """Source checkpoint + target root for an attestation at ``slot``
+        on the current head — the CACHED hot path: early-attester cache
+        first (a block imported this slot, same epoch), then the attester
+        cache (primed by the 3/4-slot timer or a previous call), then one
+        cache-filling computation (the only path that copies a state, and
+        only when the epoch is AHEAD of the head state's)."""
+        spe = self.preset.SLOTS_PER_EPOCH
+        epoch = int(slot) // spe
+        head_root = self.head.root
+        entry = self.early_attester_cache.try_attest(head_root, slot, epoch)
+        if entry is None:
+            entry = self.attester_cache.get(head_root, epoch)
+        if entry is None:
+            state = self.head.state
+            head_epoch = int(state.slot) // spe
+            if epoch == head_epoch:
+                self.attester_cache.prime_from_state(head_root, state,
+                                                     self.preset)
+            elif epoch < head_epoch:
+                # Catch-up duty for a PAST epoch: the head state still
+                # holds that epoch's boundary root and the justified
+                # checkpoint only moves forward — serve without rewind
+                # (the pre-cache code path did the same).
+                from ..state_transition.helpers import get_block_root
+                from .attester_cache import AttesterCacheEntry
+                src = state.current_justified_checkpoint
+                self.attester_cache.put(head_root, epoch, AttesterCacheEntry(
+                    source_epoch=int(src.epoch),
+                    source_root=bytes(src.root),
+                    target_root=bytes(
+                        get_block_root(state, epoch, self.preset))))
+            else:
+                advanced = self._advanced_states.get((head_root, slot))
+                if advanced is None:
+                    advanced = process_slots(
+                        state.copy(), epoch * spe, self.preset, self.spec,
+                        self.T)
+                self.attester_cache.prime_from_state(head_root, advanced,
+                                                     self.preset)
+            entry = self.attester_cache.get(head_root, epoch)
+        return entry
 
     # -- state lookup --------------------------------------------------------
 
@@ -329,6 +396,7 @@ class BeaconChain:
         choice import → persistence → head update.  Returns the block root
         (`beacon_chain.rs:2599` + `import_execution_pending_block:2679`)."""
         g = GossipVerifiedBlock.new(self, signed_block)
+        self.block_times_cache.observed(g.block_root)
         sv = SignatureVerifiedBlock.from_gossip_verified(self, g)
         ex = ExecutedBlock.from_signature_verified(self, sv)
         self._import_block(ex, is_timely=is_timely)
@@ -343,6 +411,17 @@ class BeaconChain:
         self.fork_choice.on_block(ex.signed_block, block_root, state,
                                   is_timely=is_timely)
         self._states_by_block[block_root] = state
+        self.block_times_cache.imported(block_root)
+        # Prime the attester caches from the post-state we already hold:
+        # attestations for THIS block can be produced before any head
+        # recompute or state lookup (`early_attester_cache.rs`).
+        self.attester_cache.prime_from_state(block_root, state, self.preset)
+        blk_epoch = int(state.slot) // self.preset.SLOTS_PER_EPOCH
+        entry = self.attester_cache.get(block_root, blk_epoch)
+        if entry is not None:
+            self.early_attester_cache.add(
+                block_root, int(ex.signed_block.message.slot), blk_epoch,
+                entry)
         # Feed block attestations to fork choice (`beacon_chain.rs:
         # apply_attestation_to_fork_choice` via import).
         from .attestation_verification import attesting_indices
@@ -387,6 +466,7 @@ class BeaconChain:
             state = self.state_at_block_root(head_root)
             self.head = CanonicalHead(root=head_root,
                                       slot=int(state.slot), state=state)
+            self.block_times_cache.set_as_head(head_root)
             # The post-block state's own latest_block_header.state_root is
             # ZEROED until the next slot; the advertised root comes from
             # the head block itself.

@@ -185,6 +185,7 @@ def cmd_beacon_node(args) -> int:
     last = chain.head.slot
     try:
         deadline = (time.time() + args.run_for) if args.run_for else None
+        fired_3q = -1
         while deadline is None or time.time() < deadline:
             slot = clock.now()
             if slot > last:
@@ -194,6 +195,11 @@ def cmd_beacon_node(args) -> int:
                     vc.on_slot(slot)
                 print(f"slot {slot} head={chain.head.root.hex()[:12]} "
                       f"(slot {chain.head.slot})")
+            # 3/4-slot state-advance timer (`state_advance_timer.rs`):
+            # pre-advance + prime attester caches for the NEXT slot.
+            if clock.slot_progress() >= 0.75 and fired_3q < slot:
+                fired_3q = slot
+                chain.on_three_quarters_slot(slot)
             time.sleep(0.1)
     except KeyboardInterrupt:
         pass

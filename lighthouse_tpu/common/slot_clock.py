@@ -29,6 +29,11 @@ class SlotClock:
     def seconds_into_slot(self, timestamp: float) -> float:
         return (timestamp - self.genesis_time) % self.seconds_per_slot
 
+    def slot_progress(self) -> float:
+        """Fraction of the current slot elapsed, in [0, 1) — drives the
+        3/4-slot state-advance timer (`state_advance_timer.rs:94-106`)."""
+        return self.seconds_into_slot(time.time()) / self.seconds_per_slot
+
 
 class SystemTimeSlotClock(SlotClock):
     """`SystemTimeSlotClock` — wall clock."""

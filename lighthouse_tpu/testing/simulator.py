@@ -103,7 +103,8 @@ class Simulator:
         return False
 
     def run_slot(self, slot: int) -> None:
-        """One slot: tick every chain, drive every VC, drain queues."""
+        """One slot: tick every chain, drive every VC, drain queues,
+        then fire the 3/4-slot state-advance timer for the next slot."""
         for n in self.nodes:
             n.chain.per_slot_task(slot)
         for n in self.nodes:
@@ -120,6 +121,8 @@ class Simulator:
                               for n in self.nodes)
                 if drained:
                     break
+        for n in self.nodes:  # `state_advance_timer.rs` 3/4-slot hook
+            n.chain.on_three_quarters_slot(slot)
 
     def run(self, n_slots: int) -> None:
         for slot in range(1, n_slots + 1):

@@ -22,7 +22,6 @@ from ..state_transition.committees import (
 )
 from ..state_transition.helpers import (
     current_epoch,
-    get_block_root,
     get_randao_mix,
 )
 from ..state_transition.per_block import get_expected_withdrawals
@@ -112,24 +111,20 @@ class InProcessBeaconNode:
     # -- attestation data ----------------------------------------------------
 
     def attestation_data(self, slot: int, committee_index: int):
-        """`produce_unaggregated_attestation` (`beacon_chain.rs`)."""
+        """`produce_unaggregated_attestation` (`beacon_chain.rs`) via the
+        attester caches — NO state copy or slot advance on the hot path
+        (`attester_cache.rs` / `early_attester_cache.rs`; primed by the
+        3/4-slot timer and at block import)."""
         chain = self.chain
-        preset = chain.preset
-        state = chain.head.state
-        if int(state.slot) < slot:
-            state = process_slots(state.copy(), slot, preset, chain.spec,
-                                  chain.T)
-        epoch = slot // preset.SLOTS_PER_EPOCH
-        if epoch * preset.SLOTS_PER_EPOCH == slot:
-            target_root = chain.head.root
-        else:
-            target_root = get_block_root(state, epoch, preset)
+        epoch = slot // chain.preset.SLOTS_PER_EPOCH
+        entry = chain.attestation_data_parts(slot)
         T = chain.T
         return T.AttestationData(
             slot=slot, index=committee_index,
             beacon_block_root=chain.head.root,
-            source=state.current_justified_checkpoint,
-            target=T.Checkpoint(epoch=epoch, root=target_root))
+            source=T.Checkpoint(epoch=entry.source_epoch,
+                                root=entry.source_root),
+            target=T.Checkpoint(epoch=epoch, root=entry.target_root))
 
     # -- production ----------------------------------------------------------
 

@@ -163,3 +163,85 @@ def test_produce_block_packs_pool_operations():
     assert parts["proposer_index"] == int(parts["proposer_index"])
     assert len(parts["voluntary_exits"]) == 1
     assert len(parts["attestations"]) > 0
+
+
+def test_attester_cache_serves_next_slot_without_state_work():
+    """VERDICT r4 #8 'done' criterion: after the 3/4-slot timer fires,
+    attestation data for slot N+1 is served BEFORE slot N+1's block
+    arrives, with no state copy/advance on the hot path."""
+    import lighthouse_tpu.beacon_chain.chain as CH
+    from lighthouse_tpu.validator_client import InProcessBeaconNode
+
+    h, chain = make_chain()
+    for _ in range(5):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb)
+    n = chain.head.slot
+
+    # 3/4 of slot N: pre-advance + prime for N+1.
+    chain.on_three_quarters_slot(n)
+
+    # Instrument: the hot path must not slot-advance (copy) any state.
+    calls = {"n": 0}
+    orig = CH.process_slots
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    CH.process_slots = counting
+    try:
+        bn = InProcessBeaconNode(chain)
+        data = bn.attestation_data(n + 1, 0)
+    finally:
+        CH.process_slots = orig
+    assert calls["n"] == 0, "attestation data hit the state-advance path"
+
+    # Correctness: matches the naive (state-advancing) computation.
+    from lighthouse_tpu.state_transition.helpers import get_block_root
+    from lighthouse_tpu.state_transition.per_slot import process_slots
+    state = process_slots(chain.head.state.copy(), n + 1, chain.preset,
+                          chain.spec, chain.T)
+    spe = chain.preset.SLOTS_PER_EPOCH
+    epoch = (n + 1) // spe
+    want_target = (chain.head.root if epoch * spe == n + 1
+                   else get_block_root(state, epoch, chain.preset))
+    assert bytes(data.beacon_block_root) == chain.head.root
+    assert bytes(data.target.root) == bytes(want_target)
+    assert int(data.source.epoch) == \
+        int(state.current_justified_checkpoint.epoch)
+    assert bytes(data.source.root) == \
+        bytes(state.current_justified_checkpoint.root)
+
+
+def test_early_attester_cache_serves_imported_block_instantly():
+    """A block imported this slot serves attestation data from the
+    early-attester cache (`early_attester_cache.rs`)."""
+    h, chain = make_chain()
+    sb = h.build_block()
+    h.apply_block(sb)
+    slot = int(sb.message.slot)
+    chain.per_slot_task(slot)
+    root = chain.process_block(sb)
+    entry = chain.early_attester_cache.try_attest(
+        root, slot, slot // chain.preset.SLOTS_PER_EPOCH)
+    assert entry is not None
+    parts = chain.attestation_data_parts(slot)
+    assert parts == entry
+    # block times recorded: observed <= imported <= set_as_head
+    t = chain.block_times_cache.times(root)
+    assert t.observed is not None and t.imported is not None
+    assert t.set_as_head is not None
+    assert t.observed <= t.imported <= t.set_as_head
+
+
+def test_block_times_cache_latency_metric():
+    h, chain = make_chain()
+    sb = h.build_block()
+    h.apply_block(sb)
+    chain.per_slot_task(int(sb.message.slot))
+    root = chain.process_block(sb)
+    ms = chain.block_times_cache.import_to_head_ms(root)
+    assert ms is not None and ms >= 0
