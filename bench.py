@@ -311,6 +311,14 @@ def _op_pool_bench() -> dict:
             "op_pool_packed": packed}
 
 
+def _stage_split_bench() -> dict:
+    """VERDICT r4 #2: the measured per-stage decomposition of the fused
+    pipeline (marshal/hash/prepare/Miller/fold/finalize)."""
+    from lighthouse_tpu.crypto.profiling import profile_stages
+
+    return profile_stages()
+
+
 def _slasher_bench() -> dict:
     """VERDICT r4 #9: slasher span-plane ingest at registry scale.
     history=512 bounds the planes at 2×1 GiB (the bench process already
@@ -331,6 +339,7 @@ _ROWS = [
     ("block", _block_transition_bench, "block_transition_128att"),
     ("op_pool", _op_pool_bench, "op_pool_pack_100k"),
     ("slasher", _slasher_bench, "slasher_span_update_1m"),
+    ("stages", _stage_split_bench, "bls_stage_split"),
 ]
 
 

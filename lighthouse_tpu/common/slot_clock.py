@@ -32,7 +32,7 @@ class SlotClock:
     def slot_progress(self) -> float:
         """Fraction of the current slot elapsed, in [0, 1) — drives the
         3/4-slot state-advance timer (`state_advance_timer.rs:94-106`)."""
-        return self.seconds_into_slot(time.time()) / self.seconds_per_slot
+        raise NotImplementedError
 
 
 class SystemTimeSlotClock(SlotClock):
@@ -45,6 +45,9 @@ class SystemTimeSlotClock(SlotClock):
         t = time.time()
         return self.start_of(self.slot_of(t) + 1) - t
 
+    def slot_progress(self) -> float:
+        return self.seconds_into_slot(time.time()) / self.seconds_per_slot
+
 
 class ManualSlotClock(SlotClock):
     """`ManualSlotClock`/`TestingSlotClock` — tests drive time."""
@@ -53,12 +56,22 @@ class ManualSlotClock(SlotClock):
                  slot: int = 0):
         super().__init__(genesis_time, seconds_per_slot)
         self._slot = slot
+        self._progress = 0.0
 
     def now(self) -> int:
         return self._slot
 
     def set_slot(self, slot: int) -> None:
         self._slot = slot
+        self._progress = 0.0
+
+    def set_progress(self, fraction: float) -> None:
+        """Tests drive intra-slot time explicitly (e.g. 0.75 fires the
+        state-advance timer in a cli-style loop)."""
+        self._progress = fraction
+
+    def slot_progress(self) -> float:
+        return self._progress
 
     def advance(self, n: int = 1) -> int:
         self._slot += n

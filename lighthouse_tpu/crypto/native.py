@@ -97,8 +97,27 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.bls381_g1_aggregate.argtypes = [
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.bls381_hash_to_g2_u.restype = ctypes.c_int
+        lib.bls381_hash_to_g2_u.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.bls381_g2_mul.restype = ctypes.c_int
+        lib.bls381_g2_mul.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
         _lib = lib
         return _lib
+
+
+def ready() -> bool:
+    """The standard hot-path gate: honors LIGHTHOUSE_TPU_NO_NATIVE,
+    kicks the async build, and answers WITHOUT blocking — callers fall
+    back to pure python until the build lands."""
+    if os.environ.get("LIGHTHOUSE_TPU_NO_NATIVE"):
+        return False
+    prebuild_async()
+    return available(block=False)
 
 
 def available(block: bool = True) -> bool:
@@ -163,6 +182,48 @@ def g1_aggregate(points: Sequence[tuple]) -> Optional[tuple]:
     x = sum(int(out[j]) << (64 * j) for j in range(6))
     y = sum(int(out[6 + j]) << (64 * j) for j in range(6))
     return (x, y)
+
+
+def _pack_g2_affine(point: tuple):
+    buf = (ctypes.c_uint64 * 24)()
+    buf[0:6] = _limbs(point[0][0])
+    buf[6:12] = _limbs(point[0][1])
+    buf[12:18] = _limbs(point[1][0])
+    buf[18:24] = _limbs(point[1][1])
+    return buf
+
+
+def _unpack_g2_affine(out) -> tuple:
+    v = [sum(int(out[o * 6 + j]) << (64 * j) for j in range(6))
+         for o in range(4)]
+    return ((v[0], v[1]), (v[2], v[3]))
+
+
+def hash_to_g2_u(u0: tuple, u1: tuple) -> tuple:
+    """SSWU → 3-isogeny → cofactor clearing for two Fq2 field elements
+    (the curve half of RFC 9380 hash_to_curve; ~1.5 ms vs ~20 ms python).
+    Returns the affine ((x0, x1), (y0, y1)) G2 point."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    u = _pack_g2_affine((u0, u1))  # same 4×Fq layout as an affine point
+    out = (ctypes.c_uint64 * 24)()
+    if not lib.bls381_hash_to_g2_u(u, out):
+        return None  # pathological infinity; callers treat like python's
+    return _unpack_g2_affine(out)
+
+
+def g2_mul(point: tuple, scalar: int) -> Optional[tuple]:
+    """[scalar]P for affine G2 (256-bit ladder; ~1.5 ms vs ~10 ms
+    python) — the sign/RLC hot path."""
+    lib = _load()
+    assert lib is not None, "call available() first"
+    p = _pack_g2_affine(point)
+    s = (ctypes.c_uint64 * 4)(
+        *((scalar >> (64 * i)) & 0xFFFFFFFFFFFFFFFF for i in range(4)))
+    out = (ctypes.c_uint64 * 24)()
+    if not lib.bls381_g2_mul(p, s, out):
+        return None
+    return _unpack_g2_affine(out)
 
 
 def multi_pairing_gt(pairs: Sequence[Tuple[tuple, tuple]]) -> tuple:

@@ -176,14 +176,11 @@ def multi_pairing_is_one(
     python oracle otherwise.  Disable with LIGHTHOUSE_TPU_NO_NATIVE=1
     (tests use this to cross-check the two paths).
     """
-    import os
+    from . import native
 
     live = [(p, q) for p, q in pairs if p is not None and q is not None]
-    if not os.environ.get("LIGHTHOUSE_TPU_NO_NATIVE"):
-        from . import native
-        native.prebuild_async()  # no-op once built
-        if native.available(block=False):
-            if not live:
-                return True
-            return native.multi_pairing_is_one(live)
+    if native.ready():
+        if not live:
+            return True
+        return native.multi_pairing_is_one(live)
     return multi_pairing(live) == F.FQ12_ONE

@@ -1,0 +1,66 @@
+"""Per-stage device profile of the fused BLS verify pipeline — the
+measured decomposition VERDICT r4 #2 asks for in the bench JSON.
+
+The production path is ONE jit (a single host sync), so stage costs are
+measured by queueing each kernel N× and syncing once (amortizing the
+~100 ms axon tunnel roundtrip to <10 ms/row of noise).  Shapes match the
+256-set C=2 bucket; inputs are synthetic limb planes — the kernels'
+CORRECTNESS is pinned elsewhere (host oracles + RFC anchors); this
+measures device time only.
+
+Used by ``bench.py`` (the ``bls_stage_split`` row) and
+``scripts/profile_bls.py`` (human-readable breakdown).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+def profile_stages(n: int = 10) -> Dict[str, float]:
+    """ms/call per pipeline stage at the C=2 (256-lane) shape."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from . import htc_kernel as HK
+    from . import pairing_kernel as PK
+
+    S = PK.PREP_S
+    rng = np.random.default_rng(0)
+    C = 2
+    pk = jnp.asarray(rng.integers(0, 2**16, (64, C * S)).astype(np.uint32))
+    kmask = jnp.ones((1, C * S), jnp.int32)
+    lo = jnp.ones((1, C * S), jnp.uint32)
+    hi = jnp.zeros((1, C * S), jnp.uint32)
+    g2 = jnp.asarray(rng.integers(0, 2**16, (128, C * S)).astype(np.uint32))
+    lm = jnp.ones((1, C * S), jnp.int32)
+    msgs = [(i // S, i % S, b"stage-msg-%03d" % (i % 64))
+            for i in range(C * S)]
+    ud = jnp.asarray(HK.u_planes_for_messages(msgs, C))
+
+    g1_aff, _fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
+    f = PK.miller_kernel_call(g1_aff, g2)
+    prod = PK.product_chunks_kernel_call(f, lm)
+    ok = PK.finalize_kernel_call(prod)
+    h = HK.hash_g2_kernel_call(ud)
+    jax.block_until_ready((ok, h))
+
+    stages = {
+        "hash_to_curve": lambda: HK.hash_g2_kernel_call(ud),
+        "prepare_gather_rlc": lambda: PK.prepare_kernel_call(
+            pk, kmask, lo, hi, K=1)[0],
+        "miller": lambda: PK.miller_kernel_call(g1_aff, g2),
+        "product_fold": lambda: PK.product_chunks_kernel_call(f, lm),
+        "final_exp": lambda: PK.finalize_kernel_call(prod),
+    }
+    out: Dict[str, float] = {}
+    for name, fn in stages.items():
+        t0 = time.perf_counter()
+        outs = [fn() for _ in range(n)]
+        jax.block_until_ready(outs)
+        out[f"stage_{name}_ms"] = round(
+            (time.perf_counter() - t0) * 1e3 / n, 2)
+    out["stage_shape"] = "C=2 (256 lanes), K=1"
+    return out

@@ -407,12 +407,8 @@ def _dedup_shared_keygroups(entries):
     Returns (entries', all_valid): an infinity aggregate means an
     invalid set → caller returns False (matching
     ``aggregate_public_keys`` → None → False)."""
-    import os
-    if os.environ.get("LIGHTHOUSE_TPU_NO_NATIVE"):
-        return entries, True
     from . import native
-    if not native.available(block=False):
-        native.prebuild_async()
+    if not native.ready():
         return entries, True
     counts: dict = {}
     for e in entries:
@@ -484,14 +480,10 @@ def _host_fastpath_max() -> int:
 
 
 def _host_fast(n_sets: int) -> bool:
-    import os
-    if os.environ.get("LIGHTHOUSE_TPU_NO_NATIVE"):
-        return False  # kill-switch restores the device path entirely
     if n_sets > _host_fastpath_max():
         return False
     from . import native
-    native.prebuild_async()  # no-op once built
-    return native.available(block=False)
+    return native.ready()  # honors the NO_NATIVE kill-switch
 
 
 class TpuBackend:

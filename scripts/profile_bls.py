@@ -44,36 +44,11 @@ u = HK.u_planes_for_messages(messages, 2)
 print(f"u_planes (64 msgs × reuse): {(time.perf_counter()-t0)*1e3:8.2f} ms")
 
 # --- per-kernel device cost: queue N, sync once -----------------------------
-rng = np.random.default_rng(0)
-N = 10
-C = 2
-pk = jnp.asarray(rng.integers(0, 2**16, (64, C * S)).astype(np.uint32))
-kmask = jnp.ones((1, C * S), jnp.int32)
-lo = jnp.ones((1, C * S), jnp.uint32)
-hi = jnp.zeros((1, C * S), jnp.uint32)
-g2 = jnp.asarray(rng.integers(0, 2**16, (128, C * S)).astype(np.uint32))
-lm = jnp.ones((1, C * S), jnp.int32)
-ud = jnp.asarray(u)
+from lighthouse_tpu.crypto.profiling import profile_stages
 
-g1_aff, fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
-f = PK.miller_kernel_call(g1_aff, g2)
-prod = PK.product_chunks_kernel_call(f, lm)
-ok = PK.finalize_kernel_call(prod)
-h = HK.hash_g2_kernel_call(ud)
-jax.block_until_ready((ok, h))
-
-for name, fn in [
-    ("hash_g2 (256 msgs)", lambda: HK.hash_g2_kernel_call(ud)),
-    ("prepare (C=2,K=1)", lambda: PK.prepare_kernel_call(
-        pk, kmask, lo, hi, K=1)[0]),
-    ("miller (256 lanes)", lambda: PK.miller_kernel_call(g1_aff, g2)),
-    ("product (C=2)", lambda: PK.product_chunks_kernel_call(f, lm)),
-    ("finalize (256→1)", lambda: PK.finalize_kernel_call(prod)),
-]:
-    t0 = time.perf_counter()
-    outs = [fn() for _ in range(N)]
-    jax.block_until_ready(outs)
-    print(f"{name:22s} {(time.perf_counter()-t0)*1e3/N:8.2f} ms/call")
+for name, val in profile_stages().items():
+    if name.startswith("stage_") and name.endswith("_ms"):
+        print(f"{name[6:-3]:22s} {val:8.2f} ms/call")
 
 # --- end-to-end fused verify ------------------------------------------------
 for _ in range(3):
