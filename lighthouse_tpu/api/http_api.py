@@ -223,6 +223,32 @@ class HttpApiServer:
                     "current_sync_committee_branch":
                         ["0x" + b.hex()
                          for b in bs.current_sync_committee_branch]}})
+        elif path == "/eth/v1/beacon/light_client/optimistic_update":
+            upd = chain.lc_optimistic_update
+            if upd is None:
+                h._json({"code": 404, "message": "no update yet"}, 404)
+            else:
+                h._json({"data": {
+                    "attested_header": {
+                        "beacon": to_json(upd.attested_header)},
+                    "sync_aggregate": to_json(upd.sync_aggregate),
+                    "signature_slot": str(int(upd.signature_slot))}})
+        elif path == "/eth/v1/beacon/light_client/finality_update":
+            upd = chain.lc_finality_update
+            if upd is None:
+                h._json({"code": 404, "message": "no update yet"}, 404)
+            else:
+                h._json({"data": {
+                    "attested_header": {
+                        "beacon": to_json(upd.attested_header)},
+                    "finalized_header": {
+                        "beacon": to_json(upd.finalized_header)},
+                    "finality_branch": ["0x" + b.hex()
+                                        for b in upd.finality_branch],
+                    "sync_aggregate": to_json(upd.sync_aggregate),
+                    "signature_slot": str(int(upd.signature_slot)),
+                    "finalized_checkpoint_epoch":
+                        str(int(upd.finalized_checkpoint_epoch))}})
         elif path == "/eth/v1/events":
             self._serve_events(h)
         elif path == "/metrics":

@@ -125,6 +125,8 @@ class BeaconChain:
         self.attester_cache = AttesterCache()
         self.early_attester_cache = EarlyAttesterCache()
         self.block_times_cache = BlockTimesCache()
+        self.lc_optimistic_update = None
+        self.lc_finality_update = None
         self.head = CanonicalHead(root=genesis_block_root,
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
@@ -440,6 +442,7 @@ class BeaconChain:
         self.event_bus.publish("block", {
             "slot": str(int(ex.signed_block.message.slot)),
             "block": "0x" + block_root.hex()})
+        self._produce_light_client_updates(ex.signed_block)
         self.recompute_head()
         # Bound the snapshot cache (weak #10: between finalizations this
         # otherwise held EVERY post-state — up to 2 epochs × ~100 MB at
@@ -458,6 +461,28 @@ class BeaconChain:
                          if int(s.slot) < fin_slot - 1]:
                 del self._states_by_block[root]
         self.op_pool.prune(state)
+
+    def _produce_light_client_updates(self, signed_block) -> None:
+        """Produce + cache LC finality/optimistic updates when the block
+        carries a live sync aggregate (`light_client_server_cache.rs`);
+        published on the event bus for gossip/SSE relays and served via
+        `/eth/v1/beacon/light_client/*`."""
+        if bytes(signed_block.message.parent_root) != self.head.root:
+            return  # only blocks extending the head produce updates
+        try:
+            from ..light_client import LightClientServer
+            opt, fin = LightClientServer(self).updates_for_block(
+                signed_block)
+        except Exception:
+            return  # LC production is best-effort, never blocks import
+        if opt is not None:
+            self.lc_optimistic_update = opt
+            self.event_bus.publish("light_client_optimistic_update", {
+                "slot": str(int(opt.attested_header.slot))})
+        if fin is not None:
+            self.lc_finality_update = fin
+            self.event_bus.publish("light_client_finality_update", {
+                "slot": str(int(fin.attested_header.slot))})
 
     def recompute_head(self) -> bytes:
         """`recompute_head` (`canonical_head.rs`)."""

@@ -75,6 +75,7 @@ class ManualSlotClock(SlotClock):
 
     def advance(self, n: int = 1) -> int:
         self._slot += n
+        self._progress = 0.0
         return self._slot
 
     def duration_to_next_slot(self) -> float:

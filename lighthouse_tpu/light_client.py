@@ -97,12 +97,16 @@ class LightClientUpdate:
 
 @dataclass
 class LightClientFinalityUpdate:
-    """`LightClientFinalityUpdate` — gossip topic payload."""
+    """`LightClientFinalityUpdate` — gossip topic payload.  Carries the
+    finalized checkpoint EPOCH explicitly: the checkpoint's epoch can
+    exceed finalized_header.slot // SPE when the boundary slot is empty,
+    and the client needs it to reconstruct the proven Checkpoint."""
     attested_header: object
     finalized_header: object
     finality_branch: List[bytes]
     sync_aggregate: object
     signature_slot: int
+    finalized_checkpoint_epoch: int = 0
 
 
 @dataclass
@@ -124,6 +128,15 @@ class LightClientServer:
         if bytes(hdr.state_root) == b"\x00" * 32:
             hdr.state_root = state.tree_hash_root()
         return hdr
+
+    def _block_to_header(self, block_msg):
+        """BeaconBlock -> BeaconBlockHeader (same hash_tree_root)."""
+        T = self.chain.T
+        return T.BeaconBlockHeader(
+            slot=block_msg.slot, proposer_index=block_msg.proposer_index,
+            parent_root=block_msg.parent_root,
+            state_root=block_msg.state_root,
+            body_root=block_msg.body.tree_hash_root())
 
     def bootstrap(self, block_root: Optional[bytes] = None
                   ) -> LightClientBootstrap:
@@ -147,9 +160,165 @@ class LightClientServer:
         branch, _ = state_field_proof(state, "finalized_checkpoint")
         fin_root = bytes(state.finalized_checkpoint.root)
         fin_block = self.chain.store.get_block(fin_root)
-        fin_header = (fin_block.message if fin_block is not None else None)
+        fin_header = (self._block_to_header(fin_block.message)
+                      if fin_block is not None else None)
         return LightClientFinalityUpdate(
             attested_header=self._header(state),
             finalized_header=fin_header,
             finality_branch=branch,
+            sync_aggregate=sync_aggregate, signature_slot=signature_slot,
+            finalized_checkpoint_epoch=int(state.finalized_checkpoint.epoch))
+
+    def update(self, sync_aggregate,
+               signature_slot: int) -> LightClientUpdate:
+        """Period-advancing `LightClientUpdate`
+        (`light_client_update.rs` production): carries the NEXT sync
+        committee with its proof so a client can cross sync-committee
+        periods."""
+        state = self.chain.head.state
+        next_branch, _ = state_field_proof(state, "next_sync_committee")
+        fin_branch, _ = state_field_proof(state, "finalized_checkpoint")
+        fin_root = bytes(state.finalized_checkpoint.root)
+        fin_block = self.chain.store.get_block(fin_root)
+        return LightClientUpdate(
+            attested_header=self._header(state),
+            next_sync_committee=state.next_sync_committee,
+            next_sync_committee_branch=next_branch,
+            finalized_header=(self._block_to_header(fin_block.message)
+                              if fin_block is not None else None),
+            finality_branch=fin_branch,
             sync_aggregate=sync_aggregate, signature_slot=signature_slot)
+
+    def updates_for_block(self, signed_block):
+        """Artifacts triggered by an imported block carrying a live sync
+        aggregate (`beacon_chain/src/light_client_server_cache.rs` role):
+        the aggregate attests to the PARENT header.  Returns
+        (optimistic_update | None, finality_update | None)."""
+        import numpy as np
+
+        agg = getattr(signed_block.message.body, "sync_aggregate", None)
+        if agg is None:
+            return None, None
+        bits = np.asarray(agg.sync_committee_bits, dtype=bool)
+        if not bits.any():
+            return None, None
+        parent = self.chain.store.get_block(
+            bytes(signed_block.message.parent_root))
+        if parent is None:
+            return None, None
+        parent_state = self.chain.state_at_block_root(
+            bytes(signed_block.message.parent_root))
+        hdr = parent_state.latest_block_header.copy()
+        hdr.state_root = bytes(parent.message.state_root)
+        slot = int(signed_block.message.slot)
+        opt = LightClientOptimisticUpdate(
+            attested_header=hdr, sync_aggregate=agg, signature_slot=slot)
+        fin_branch, _ = state_field_proof(parent_state,
+                                          "finalized_checkpoint")
+        fin_root = bytes(parent_state.finalized_checkpoint.root)
+        fin_block = self.chain.store.get_block(fin_root)
+        fin = None
+        if fin_block is not None:
+            fin = LightClientFinalityUpdate(
+                attested_header=hdr,
+                finalized_header=self._block_to_header(fin_block.message),
+                finality_branch=fin_branch,
+                sync_aggregate=agg, signature_slot=slot,
+                finalized_checkpoint_epoch=int(
+                    parent_state.finalized_checkpoint.epoch))
+        return opt, fin
+
+
+class LightClientStore:
+    """The CLIENT side — a light client following the chain from a
+    bootstrap using sync-committee-signed updates
+    (`consensus/types/src/light_client_update.rs` verification rules +
+    the spec's `process_light_client_update`, simplified to the
+    single-period flow this framework's tests drive end-to-end)."""
+
+    MIN_SYNC_PARTICIPANTS = 1
+
+    def __init__(self, bootstrap: LightClientBootstrap,
+                 trusted_block_root: bytes, state, T, preset, spec):
+        if not bootstrap.verify(trusted_block_root, state, T):
+            raise ValueError("bootstrap proof invalid for trusted root")
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+        self.T = T
+        self.preset = preset
+        self.spec = spec
+        self._genesis_validators_root = bytes(state.genesis_validators_root)
+        # precomputed proof index; holding the state itself would pin
+        # ~100 MB at registry scale for one FIELDS lookup
+        self._finalized_cp_index = list(type(state).FIELDS).index(
+            "finalized_checkpoint")
+
+    def _verify_sync_aggregate(self, attested_header, sync_aggregate,
+                               signature_slot: int) -> bool:
+        """The committee signed the attested header's root at
+        signature_slot − 1's epoch domain."""
+        import numpy as np
+
+        from .crypto.bls import PublicKey, Signature, get_backend
+        from .state_transition.helpers import (
+            compute_domain, compute_signing_root)
+        from .types.chain_spec import Domain
+
+        bits = np.asarray(sync_aggregate.sync_committee_bits, dtype=bool)
+        if int(bits.sum()) < self.MIN_SYNC_PARTICIPANTS:
+            return False
+        sig = Signature.deserialize(
+            sync_aggregate.sync_committee_signature)
+        prev = max(int(signature_slot), 1) - 1
+        epoch = prev // self.preset.SLOTS_PER_EPOCH
+        fork = self.spec.fork_name_at_epoch(epoch)
+        domain = compute_domain(Domain.SYNC_COMMITTEE,
+                                self.spec.fork_version(fork),
+                                self._genesis_validators_root)
+        keys = [PublicKey.deserialize(
+                    self.current_sync_committee.pubkeys[i])
+                for i in np.flatnonzero(bits)]
+        root = attested_header.tree_hash_root()
+        msg = compute_signing_root(root, domain)
+        return get_backend().verify(sig, keys, msg)
+
+    def process_optimistic_update(
+            self, update: LightClientOptimisticUpdate) -> bool:
+        if int(update.attested_header.slot) <= \
+                int(self.optimistic_header.slot):
+            return False  # not newer
+        if not self._verify_sync_aggregate(
+                update.attested_header, update.sync_aggregate,
+                update.signature_slot):
+            return False
+        self.optimistic_header = update.attested_header
+        return True
+
+    def process_finality_update(
+            self, update: LightClientFinalityUpdate) -> bool:
+        if update.finalized_header is None:
+            return False
+        if not self._verify_sync_aggregate(
+                update.attested_header, update.sync_aggregate,
+                update.signature_slot):
+            return False
+        # The finalized checkpoint proof anchors the finalized header to
+        # the attested header's state.
+        idx = self._finalized_cp_index
+        fin_root = update.finalized_header.tree_hash_root()
+        # finality_branch proves the Checkpoint container, whose root
+        # commits to (epoch, root=finalized block root).
+        cp = self.T.Checkpoint(
+            epoch=int(update.finalized_checkpoint_epoch), root=fin_root)
+        if not verify_field_proof(
+                cp.tree_hash_root(), update.finality_branch, idx,
+                bytes(update.attested_header.state_root)):
+            return False
+        if int(update.finalized_header.slot) > \
+                int(self.finalized_header.slot):
+            self.finalized_header = update.finalized_header
+        if int(update.attested_header.slot) > \
+                int(self.optimistic_header.slot):
+            self.optimistic_header = update.attested_header
+        return True

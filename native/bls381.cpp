@@ -915,16 +915,16 @@ static void sswu_map(Fp2 &x, Fp2 &y, const Fp2 &t) {
     if (fp2_sqrt_or_z(root, gx1)) {
         x = x1; y = root;
     } else {
-        // x2 = Zt²·x1; g(x2) = (Zt²)³ g(x1); sqrt_or_z returned
-        // root² = Z·g(x1), so y2 = t³·root·... — recompute directly for
-        // clarity (non-hot path): y = sqrt(g(x2)) must exist.
+        // x2 = Zt²·x1 and g(x2) = (Zt²)³·g(x1) = Z²t⁶ · (Z·g(x1));
+        // sqrt_or_z returned root² = Z·g(x1), so y2 = Z·t³·root — three
+        // Fq2 muls instead of a second 761-bit exponentiation (this
+        // branch runs for ~half of hash-derived inputs).
         fp2_mul(x, tv1, x1);
-        Fp2 gx2;
-        gx_twist(gx2, x);
-        Fp2 r2;
-        bool ok = fp2_sqrt_or_z(r2, gx2);
-        (void)ok;  // g(x2) is a square by SSWU construction
-        y = r2;
+        Fp2 t3;
+        fp2_sqr(t3, t);
+        fp2_mul(t3, t3, t);                 // t³
+        fp2_mul(y, t3, root);
+        fp2_mul(y, *c2(H2C_Z_SSWU), y);     // Z·t³·root
     }
     if (fp2_sgn0(t) != fp2_sgn0(y)) fp2_neg(y, y);
 }
