@@ -411,15 +411,49 @@ class HttpApiServer:
                 return
             chain.process_attestation_batch(atts)
             h._json({})
-        elif path == "/eth/v1/beacon/pool/voluntary_exits":
-            from ..ssz.json import from_json
-            try:
-                exit_ = from_json(chain.T.SignedVoluntaryExit,
-                                  json.loads(body))
-            except (ValueError, KeyError, TypeError) as e:
-                h._json({"code": 400, "message": str(e)}, 400)
-                return
-            chain.op_pool.insert_voluntary_exit(exit_)
-            h._json({})
+        elif path.startswith("/eth/v1/beacon/pool/"):
+            self._pool_submit(h, path, body)
         else:
             h._json({"code": 404, "message": "unknown route"}, 404)
+
+    # One table drives every SigVerifiedOp pool route: the verified
+    # wrapper's payload attribute differs per op, hence the getter.
+    def _pool_submit(self, h, path: str, body: bytes) -> None:
+        from ..beacon_chain import verify_operation as VO
+        from ..ssz.json import from_json
+
+        chain = self.chain
+        T = chain.T
+        table = {
+            "/eth/v1/beacon/pool/voluntary_exits": (
+                T.SignedVoluntaryExit, VO.verify_voluntary_exit,
+                lambda v: chain.op_pool.insert_voluntary_exit(
+                    v.signed_exit)),
+            "/eth/v1/beacon/pool/proposer_slashings": (
+                T.ProposerSlashing, VO.verify_proposer_slashing,
+                lambda v: chain.op_pool.insert_proposer_slashing(
+                    v.slashing)),
+            "/eth/v1/beacon/pool/attester_slashings": (
+                T.AttesterSlashing, VO.verify_attester_slashing,
+                lambda v: chain.op_pool.insert_attester_slashing(
+                    v.slashing)),
+            "/eth/v1/beacon/pool/bls_to_execution_changes": (
+                T.SignedBLSToExecutionChange,
+                VO.verify_bls_to_execution_change,
+                lambda v: chain.op_pool.insert_bls_to_execution_change(
+                    v.change)),
+        }
+        entry = table.get(path)
+        if entry is None:
+            h._json({"code": 404, "message": "unknown route"}, 404)
+            return
+        cls, verify, insert = entry
+        try:
+            op = from_json(cls, json.loads(body))
+            verified = verify(chain, op)
+        except (VO.OpVerificationError, ValueError, KeyError,
+                TypeError) as e:
+            h._json({"code": 400, "message": str(e)}, 400)
+            return
+        insert(verified)
+        h._json({})

@@ -1,0 +1,175 @@
+"""Gossip-operation verification before pool insert — the
+``SigVerifiedOp`` pattern of
+``/root/reference/consensus/state_processing/src/verify_operation.rs``:
+exits, slashings, and BLS-to-execution changes arriving from gossip or
+the HTTP API are STATE-CHECKED and SIGNATURE-VERIFIED against the head
+state before they may enter the op pool — an unverified op in the pool
+would otherwise surface in a produced block and make the proposer build
+an invalid block.
+
+All checks are read-only on the head state (no copy: validation rules
+only read the registry columns and checkpoints; the heavyweight
+application happens at block processing).  Each function returns the
+verified wrapper or raises :class:`OpVerificationError`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..crypto import bls
+from ..state_transition import signature_sets as sigs
+from ..state_transition.helpers import (
+    FAR_FUTURE_EPOCH,
+    current_epoch,
+    is_active_at,
+    is_slashable_at,
+)
+from ..state_transition.per_block import is_slashable_attestation_data
+
+
+class OpVerificationError(ValueError):
+    pass
+
+
+def _verify_sets(build) -> None:
+    """``build`` is a thunk returning the signature sets: constructing a
+    set DESERIALIZES signatures/pubkeys, and a malformed point must read
+    as an invalid op, not an internal error."""
+    try:
+        sets = build()
+        live = [s for s in sets if s is not None]
+        ok = bls.verify_signature_sets(live) if live else True
+    except bls.BlsError as e:
+        raise OpVerificationError(f"malformed signature: {e}") from e
+    if not ok:
+        raise OpVerificationError("signature verification failed")
+
+
+@dataclass(frozen=True)
+class SigVerifiedExit:
+    signed_exit: object
+
+
+def verify_voluntary_exit(chain, signed_exit) -> SigVerifiedExit:
+    """`VoluntaryExit::validate` (`verify_operation.rs` exit arm)."""
+    state = chain.head.state
+    preset, spec = chain.preset, chain.spec
+    exit_ = signed_exit.message
+    idx = int(exit_.validator_index)
+    reg = state.validators
+    epoch = current_epoch(state, preset)
+    if idx >= len(reg):
+        raise OpVerificationError("exit: unknown validator")
+    if not bool(is_active_at(reg, epoch)[idx]):
+        raise OpVerificationError("exit: validator not active")
+    if int(reg.col("exit_epoch")[idx]) != FAR_FUTURE_EPOCH:
+        raise OpVerificationError("exit: already exiting")
+    if epoch < int(exit_.epoch):
+        raise OpVerificationError("exit: not yet valid")
+    if epoch < int(reg.col("activation_epoch")[idx]) + \
+            spec.shard_committee_period:
+        raise OpVerificationError("exit: validator too young")
+    _verify_sets(lambda: [sigs.voluntary_exit_signature_set(
+        state, signed_exit, chain.pubkey_cache, preset)])
+    return SigVerifiedExit(signed_exit)
+
+
+@dataclass(frozen=True)
+class SigVerifiedProposerSlashing:
+    slashing: object
+
+
+def verify_proposer_slashing(chain, slashing) -> SigVerifiedProposerSlashing:
+    state = chain.head.state
+    preset = chain.preset
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    if int(h1.slot) != int(h2.slot):
+        raise OpVerificationError("proposer slashing: slot mismatch")
+    if int(h1.proposer_index) != int(h2.proposer_index):
+        raise OpVerificationError("proposer slashing: proposer mismatch")
+    if h1.tree_hash_root() == h2.tree_hash_root():
+        raise OpVerificationError("proposer slashing: identical headers")
+    idx = int(h1.proposer_index)
+    reg = state.validators
+    if idx >= len(reg):
+        raise OpVerificationError("proposer slashing: unknown proposer")
+    epoch = current_epoch(state, preset)
+    if not bool(is_slashable_at(reg, epoch)[idx]):
+        raise OpVerificationError("proposer slashing: not slashable")
+    cache = chain.pubkey_cache
+    _verify_sets(lambda: [
+        sigs.block_header_signature_set(
+            state, slashing.signed_header_1, cache, preset),
+        sigs.block_header_signature_set(
+            state, slashing.signed_header_2, cache, preset)])
+    return SigVerifiedProposerSlashing(slashing)
+
+
+@dataclass(frozen=True)
+class SigVerifiedAttesterSlashing:
+    slashing: object
+
+
+def verify_attester_slashing(chain, slashing) -> SigVerifiedAttesterSlashing:
+    state = chain.head.state
+    preset = chain.preset
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    if not is_slashable_attestation_data(a1.data, a2.data):
+        raise OpVerificationError("attester slashing: not slashable")
+    cache = chain.pubkey_cache
+    for att in (a1, a2):
+        idxs = [int(i) for i in att.attesting_indices]
+        if not idxs or idxs != sorted(set(idxs)):
+            raise OpVerificationError(
+                "attester slashing: indices not sorted/unique")
+        if idxs[-1] >= len(state.validators):
+            raise OpVerificationError(
+                "attester slashing: unknown validator")
+
+    def build():
+        return [sigs.indexed_attestation_signature_set(
+            state, np.asarray([int(i) for i in att.attesting_indices]),
+            att.signature, att.data, cache, preset)
+            for att in (a1, a2)]
+    # At least one validator must be slashable by BOTH attestations.
+    common = set(int(i) for i in a1.attesting_indices) & \
+        set(int(i) for i in a2.attesting_indices)
+    reg = state.validators
+    epoch = current_epoch(state, preset)
+    mask = is_slashable_at(reg, epoch)
+    if not any(bool(mask[v]) for v in common):
+        raise OpVerificationError(
+            "attester slashing: no slashable intersection")
+    _verify_sets(build)
+    return SigVerifiedAttesterSlashing(slashing)
+
+
+@dataclass(frozen=True)
+class SigVerifiedBlsToExecutionChange:
+    change: object
+
+
+def verify_bls_to_execution_change(chain, signed_change
+                                   ) -> SigVerifiedBlsToExecutionChange:
+    state = chain.head.state
+    change = signed_change.message
+    idx = int(change.validator_index)
+    reg = state.validators
+    if idx >= len(reg):
+        raise OpVerificationError("address change: unknown validator")
+    creds = bytes(reg.col("withdrawal_credentials")[idx].tobytes())
+    if creds[0:1] != b"\x00":
+        raise OpVerificationError(
+            "address change: not BLS withdrawal credentials")
+    import hashlib
+    if creds[1:] != hashlib.sha256(
+            bytes(change.from_bls_pubkey)).digest()[1:]:
+        raise OpVerificationError("address change: pubkey hash mismatch")
+    _verify_sets(lambda: [sigs.bls_to_execution_change_signature_set(
+        state, signed_change, chain.spec.genesis_fork_version,
+        chain.preset)])
+    return SigVerifiedBlsToExecutionChange(signed_change)
