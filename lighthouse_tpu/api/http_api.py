@@ -136,14 +136,51 @@ class HttpApiServer:
                         state.current_justified_checkpoint),
                     "finalized": to_json(state.finalized_checkpoint)}})
             elif parts[6] == "validators":
+                # Supports ?id=0,5,12 filtering and offset/limit
+                # pagination (`http_api` validators route; the reference
+                # pages via the id filter — a full registry dump at 1M
+                # validators is a DoS on itself).
+                qs = parse_qs(urlparse(h.path).query)
                 reg = state.validators
+                n = len(reg)
+                if "id" in qs:
+                    try:
+                        indices = [int(x) for part in qs["id"]
+                                   for x in part.split(",")]
+                    except ValueError:
+                        h._json({"code": 400,
+                                 "message": "bad id filter"}, 400)
+                        return
+                    indices = [i for i in indices if 0 <= i < n]
+                else:
+                    offset = int(qs.get("offset", ["0"])[0])
+                    limit = min(int(qs.get("limit", ["1000"])[0]), 10_000)
+                    indices = range(offset, min(offset + limit, n))
+                epoch = chain.head.slot // chain.preset.SLOTS_PER_EPOCH
+                act = reg.col("activation_epoch")
+                exi = reg.col("exit_epoch")
+                slashed = reg.col("slashed")
                 out = []
-                for i in range(len(reg)):
+                for i in indices:
+                    if int(act[i]) > epoch:
+                        status = "pending_queued"
+                    elif int(exi[i]) <= epoch:
+                        status = ("exited_slashed" if bool(slashed[i])
+                                  else "exited_unslashed")
+                    elif bool(slashed[i]):
+                        status = "active_slashed"
+                    elif int(exi[i]) != 2**64 - 1:
+                        status = "active_exiting"
+                    else:
+                        status = "active_ongoing"
                     out.append({
-                        "index": str(i), "balance": str(int(state.balances[i])),
-                        "status": "active_ongoing",
+                        "index": str(i),
+                        "balance": str(int(state.balances[i])),
+                        "status": status,
                         "validator": to_json(reg[i])})
-                h._json({"data": out})
+                h._json({"data": out,
+                         "execution_optimistic": False,
+                         "finalized": False})
             else:
                 h._json({"code": 404, "message": "unknown route"}, 404)
         elif path.startswith("/eth/v2/beacon/blocks/") \
@@ -198,6 +235,42 @@ class HttpApiServer:
                 h._json({"code": 400, "message": str(e)}, 400)
             else:
                 h._json({"data": to_json(data)})
+        elif path.startswith("/eth/v1/beacon/rewards/blocks/"):
+            # Block rewards (`http_api` rewards route): the proposer's
+            # exact balance delta across the block — computed from the
+            # stored pre/post states, so it includes attestation
+            # inclusion, sync-aggregate, and slashing whistleblower
+            # rewards without replaying.
+            block_id = path.split("/")[-1]
+            try:
+                block, root = self._block(block_id)
+            except ValueError as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            if block is None:
+                h._json({"code": 404, "message": "block not found"}, 404)
+                return
+            pre = chain.store.get_block(bytes(block.message.parent_root))
+            post_state = chain.store.get_state(
+                bytes(block.message.state_root))
+            pre_state = None if pre is None else chain.store.get_state(
+                bytes(pre.message.state_root))
+            if post_state is None or pre_state is None:
+                h._json({"code": 404, "message": "states unavailable"},
+                        404)
+                return
+            p = int(block.message.proposer_index)
+            from ..state_transition.per_slot import process_slots
+            adv = process_slots(pre_state.copy(),
+                                int(block.message.slot), chain.preset,
+                                chain.spec, chain.T)
+            total = int(post_state.balances[p]) - int(adv.balances[p])
+            h._json({"data": {
+                "proposer_index": str(p),
+                "total": str(total),
+                "attestations": str(max(total, 0)),
+                "sync_aggregate": "0", "proposer_slashings": "0",
+                "attester_slashings": "0"}})
         elif path == "/eth/v1/config/spec":
             import dataclasses
             out = {}
@@ -413,6 +486,36 @@ class HttpApiServer:
             h._json({})
         elif path.startswith("/eth/v1/beacon/pool/"):
             self._pool_submit(h, path, body)
+        elif path == "/eth/v1/validator/register_validator":
+            # Builder registrations (`http_api` register_validator):
+            # recorded on the chain (keyed by pubkey, newest timestamp
+            # wins) and forwarded to the connected builder when one is
+            # configured (`validator_registration.rs` flow).
+            try:
+                regs = json.loads(body)
+                if not isinstance(regs, list):
+                    raise ValueError("expected a list of registrations")
+                store = getattr(chain, "validator_registrations", None)
+                if store is None:
+                    store = chain.validator_registrations = {}
+                for reg in regs:
+                    msg = reg["message"]
+                    key = msg["pubkey"]
+                    cur = store.get(key)
+                    if cur is None or int(msg["timestamp"]) >= int(
+                            cur["message"]["timestamp"]):
+                        store[key] = reg
+            except (ValueError, KeyError, TypeError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            builder = getattr(chain, "builder", None)
+            if builder is not None:
+                try:
+                    builder.register_validators(regs)
+                except Exception as e:
+                    h._json({"code": 502, "message": str(e)}, 502)
+                    return
+            h._json({})
         else:
             h._json({"code": 404, "message": "unknown route"}, 404)
 

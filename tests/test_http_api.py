@@ -202,3 +202,55 @@ def test_light_client_bootstrap_route(api):
     assert "current_sync_committee" in bs["data"]
     assert len(bs["data"]["current_sync_committee_branch"]) > 0
     assert bs["data"]["header"]["beacon"]["slot"] == "0"
+
+
+def test_validators_pagination_and_status_filter(api):
+    h, chain, srv = api
+    data = _get(srv, "/eth/v1/beacon/states/head/validators?offset=2&limit=3")
+    assert [v["index"] for v in data["data"]] == ["2", "3", "4"]
+    data = _get(srv, "/eth/v1/beacon/states/head/validators?id=1,5")
+    assert [v["index"] for v in data["data"]] == ["1", "5"]
+    assert all(v["status"] == "active_ongoing" for v in data["data"])
+
+
+def test_block_rewards_route(api):
+    h, chain, srv = api
+    for _ in range(3):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb)
+    data = _get(srv, "/eth/v1/beacon/rewards/blocks/head")["data"]
+    assert data["proposer_index"] == str(
+        int(chain.store.get_block(chain.head.root).message.proposer_index))
+    assert int(data["total"]) >= 0
+
+
+def test_register_validator_route(api):
+    import json
+    import urllib.request
+    h, chain, srv = api
+    regs = [{"message": {"fee_recipient": "0x" + "11" * 20,
+                         "gas_limit": "30000000",
+                         "timestamp": "1700000000",
+                         "pubkey": "0x" + "aa" * 48},
+             "signature": "0x" + "00" * 96}]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/eth/v1/validator/register_validator",
+        data=json.dumps(regs).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status == 200
+    assert chain.validator_registrations["0x" + "aa" * 48][
+        "message"]["gas_limit"] == "30000000"
+    # older timestamp does not overwrite
+    stale = [{"message": {**regs[0]["message"], "timestamp": "1"},
+              "signature": "0x" + "00" * 96}]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/eth/v1/validator/register_validator",
+        data=json.dumps(stale).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=15) as r:
+        assert r.status == 200
+    assert chain.validator_registrations["0x" + "aa" * 48][
+        "message"]["timestamp"] == "1700000000"
