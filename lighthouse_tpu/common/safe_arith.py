@@ -1,0 +1,59 @@
+"""Safe arithmetic — the role of ``consensus/safe_arith``
+(``/root/reference/consensus/safe_arith/src/lib.rs``): spec math is
+u64 with DEFINED overflow behavior (an overflowing block is INVALID,
+not a wrapped number).
+
+Python ints don't overflow, so the risk here is inverted: a negative
+intermediate or an over-wide value silently flows into a numpy uint64
+column and WRAPS there.  These helpers make the u64 bounds explicit at
+the spec seams; `state_transition` uses them where the reference calls
+``safe_add``/``safe_sub``/``safe_mul``.
+"""
+
+from __future__ import annotations
+
+U64_MAX = 2**64 - 1
+
+
+class ArithError(OverflowError):
+    """The reference's ``ArithError`` — consensus code treats it as
+    'operation invalid', never as a crash."""
+
+
+def safe_add(a: int, b: int) -> int:
+    r = int(a) + int(b)
+    if r > U64_MAX:
+        raise ArithError(f"u64 add overflow: {a} + {b}")
+    return r
+
+
+def safe_sub(a: int, b: int) -> int:
+    r = int(a) - int(b)
+    if r < 0:
+        raise ArithError(f"u64 sub underflow: {a} - {b}")
+    return r
+
+
+def safe_mul(a: int, b: int) -> int:
+    r = int(a) * int(b)
+    if r > U64_MAX:
+        raise ArithError(f"u64 mul overflow: {a} * {b}")
+    return r
+
+
+def safe_div(a: int, b: int) -> int:
+    if int(b) == 0:
+        raise ArithError(f"division by zero: {a} / {b}")
+    return int(a) // int(b)
+
+
+def saturating_sub(a: int, b: int) -> int:
+    """``saturating_sub`` — clamps at zero (balance decreases)."""
+    return max(int(a) - int(b), 0)
+
+
+def assert_u64(v: int, what: str = "value") -> int:
+    v = int(v)
+    if not 0 <= v <= U64_MAX:
+        raise ArithError(f"{what} out of u64 range: {v}")
+    return v

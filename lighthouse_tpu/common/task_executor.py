@@ -1,0 +1,87 @@
+"""Task executor — the role of ``common/task_executor``
+(``/root/reference/common/task_executor/src/lib.rs``): every long-lived
+service thread registers here, so shutdown is one call that signals,
+joins, and reports stragglers, and metrics expose what is running.
+
+The reference wraps a tokio runtime handle + exit futures + a shutdown
+channel; this build's runtime is OS threads, so the executor wraps
+daemon threads with a shared shutdown :class:`threading.Event` and a
+registry the metrics endpoint can read (``async_tasks_count`` role).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .metrics import REGISTRY
+
+
+@dataclass
+class _Task:
+    name: str
+    thread: threading.Thread
+    critical: bool = False
+
+
+class TaskExecutor:
+    """Spawn/track/shutdown for service threads."""
+
+    def __init__(self, log=None):
+        self.log = log
+        self.shutdown_signal = threading.Event()
+        self._tasks: List[_Task] = []
+        self._lock = threading.Lock()
+        self._gauge = REGISTRY.gauge(
+            "task_executor_tasks", "Live service threads")
+
+    def spawn(self, fn: Callable[[threading.Event], None], name: str,
+              critical: bool = False) -> threading.Thread:
+        """Run ``fn(shutdown_event)`` on a named daemon thread.  The fn
+        must poll/wait on the event and return when it fires.  A CRITICAL
+        task dying triggers executor-wide shutdown (`task_executor`'s
+        ``spawn_monitor`` semantics: losing the beacon processor is fatal,
+        losing a metrics scraper is not)."""
+
+        def runner():
+            try:
+                fn(self.shutdown_signal)
+            except Exception:
+                if self.log is not None:
+                    self.log.warn("task died", task=name)
+                if critical:
+                    self.shutdown_signal.set()
+            finally:
+                with self._lock:
+                    self._tasks[:] = [t for t in self._tasks
+                                      if t.thread is not thread]
+                self._gauge.set(len(self._tasks))
+
+        thread = threading.Thread(target=runner, name=name, daemon=True)
+        with self._lock:
+            self._tasks.append(_Task(name=name, thread=thread,
+                                     critical=critical))
+        self._gauge.set(len(self._tasks))
+        thread.start()
+        return thread
+
+    def running(self) -> List[str]:
+        with self._lock:
+            return [t.name for t in self._tasks if t.thread.is_alive()]
+
+    def shutdown(self, timeout: float = 5.0) -> List[str]:
+        """Signal + join; returns the names of stragglers that failed to
+        stop within the timeout (logged, like the reference's exit
+        timeout warnings)."""
+        self.shutdown_signal.set()
+        with self._lock:
+            tasks = list(self._tasks)
+        stragglers = []
+        for t in tasks:
+            t.thread.join(timeout=timeout)
+            if t.thread.is_alive():
+                stragglers.append(t.name)
+                if self.log is not None:
+                    self.log.warn("task did not stop", task=t.name)
+        return stragglers

@@ -88,12 +88,19 @@ def get_total_active_balance(state, preset) -> int:
 # -- balances ---------------------------------------------------------------
 
 def increase_balance(state, index: int, delta: int) -> None:
-    state.balances[index] += np.uint64(delta)
+    """``safe_add`` discipline (`safe_arith`): a u64 overflow here is an
+    INVALID operation, not a wrapped numpy value silently entering the
+    balance column."""
+    from ..common.safe_arith import safe_add
+    state.balances[index] = np.uint64(
+        safe_add(int(state.balances[index]), delta))
 
 
 def decrease_balance(state, index: int, delta: int) -> None:
-    bal = int(state.balances[index])
-    state.balances[index] = np.uint64(max(bal - delta, 0))
+    """``saturating_sub`` per spec (balances clamp at zero)."""
+    from ..common.safe_arith import saturating_sub
+    state.balances[index] = np.uint64(
+        saturating_sub(int(state.balances[index]), delta))
 
 
 # -- roots / mixes / seeds ---------------------------------------------------

@@ -242,3 +242,74 @@ def test_registry_limit1_root_matches_generic():
     reg = ValidatorRegistry.from_validators([v])
     assert ValidatorRegistryList(1).hash_tree_root(reg) \
         == List(Validator, 1).hash_tree_root([v])
+
+
+def test_safe_arith_bounds():
+    """`consensus/safe_arith` discipline (VERDICT r4 row 25)."""
+    import pytest
+
+    from lighthouse_tpu.common.safe_arith import (
+        U64_MAX, ArithError, assert_u64, safe_add, safe_div, safe_mul,
+        safe_sub, saturating_sub)
+
+    assert safe_add(U64_MAX - 1, 1) == U64_MAX
+    with pytest.raises(ArithError):
+        safe_add(U64_MAX, 1)
+    assert safe_sub(5, 5) == 0
+    with pytest.raises(ArithError):
+        safe_sub(4, 5)
+    with pytest.raises(ArithError):
+        safe_mul(2**33, 2**33)
+    with pytest.raises(ArithError):
+        safe_div(1, 0)
+    assert saturating_sub(3, 10) == 0
+    assert assert_u64(U64_MAX) == U64_MAX
+    with pytest.raises(ArithError):
+        assert_u64(-1)
+
+    # the balance seams: overflow raises, decrease saturates
+    import numpy as np
+
+    from lighthouse_tpu.state_transition.helpers import (
+        decrease_balance, increase_balance)
+
+    class S:
+        balances = np.array([U64_MAX - 5, 100], dtype=np.uint64)
+
+    with pytest.raises(ArithError):
+        increase_balance(S, 0, 10)
+    decrease_balance(S, 1, 200)
+    assert int(S.balances[1]) == 0
+
+
+def test_task_executor_lifecycle():
+    """`common/task_executor` role (VERDICT r4 row 45)."""
+    import threading
+    import time
+
+    from lighthouse_tpu.common.task_executor import TaskExecutor
+
+    ex = TaskExecutor()
+    ticks = {"n": 0}
+
+    def service(stop: threading.Event):
+        while not stop.wait(0.01):
+            ticks["n"] += 1
+
+    ex.spawn(service, "ticker")
+    time.sleep(0.1)  # let it tick before the critical crash stops all
+    crashed = threading.Event()
+
+    def dies(stop: threading.Event):
+        crashed.set()
+        raise RuntimeError("boom")
+
+    ex.spawn(dies, "crasher", critical=True)
+    crashed.wait(2)
+    time.sleep(0.05)
+    # critical task death triggers executor-wide shutdown
+    assert ex.shutdown_signal.is_set()
+    stragglers = ex.shutdown(timeout=2)
+    assert stragglers == []
+    assert ticks["n"] > 0
+    assert ex.running() == []
