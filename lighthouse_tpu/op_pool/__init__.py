@@ -251,27 +251,38 @@ def _pack_columnar(candidates, balances, seen_cur, seen_prev,
     time (the 100k-candidate BASELINE row-5 shape).  Equivalence with the
     dict path is asserted in tests."""
     N = len(candidates)
-    W = max(len(s.committee) for s, _ in candidates)
+    ws = np.fromiter((len(s.committee) for s, _ in candidates),
+                     np.int64, N)
+    W = int(ws.max())
+    # Scatter the ragged committees/bits into the padded matrices in one
+    # flat assignment (a 100k-iteration python fill loop was ~half the
+    # pack time at the BASELINE row-5 shape).
+    flat_comm = np.concatenate([np.asarray(s.committee, np.int64)
+                                for s, _ in candidates])
+    flat_bit = np.concatenate(
+        [np.asarray(s.bits[:w], bool)
+         for (s, _), w in zip(candidates, ws)])
+    rows = np.repeat(np.arange(N), ws)
+    cols = np.arange(ws.sum()) - np.repeat(np.cumsum(ws) - ws, ws)
     comms = np.zeros((N, W), np.int64)
     bits = np.zeros((N, W), bool)
-    is_cur = np.zeros(N, bool)
-    for i, (s, cur) in enumerate(candidates):
-        w = len(s.committee)
-        comms[i, :w] = s.committee
-        bits[i, :w] = s.bits[:w]
-        bits[i, w:] = False
-        is_cur[i] = cur
+    comms[rows, cols] = flat_comm
+    bits[rows, cols] = flat_bit
+    is_cur = np.fromiter((cur for _, cur in candidates), bool, N)
     seen = np.empty((N, W), bool)
     seen[is_cur] = seen_cur[comms[is_cur]]
     seen[~is_cur] = seen_prev[comms[~is_cur]]
     live = bits & ~seen
     elem_w = balances[comms].astype(np.int64)
     weights = (elem_w * live).sum(1)
-    # Element → candidate reverse index (flat, sorted by element).
+    # Element → candidate reverse index (flat, grouped by element).
+    # Within-group order is irrelevant downstream (groups feed a
+    # np.unique), so the default quicksort beats the stable mergesort
+    # that dominated the 100k-candidate profile.
     lv = live.ravel()
     flat_c = np.repeat(np.arange(N), W)[lv]
     flat_e = comms.ravel()[lv]
-    order = np.argsort(flat_e, kind="stable")
+    order = np.argsort(flat_e)
     sorted_e = flat_e[order]
     sorted_c = flat_c[order]
     covered = np.zeros(balances.shape[0], bool)
