@@ -201,6 +201,13 @@ class BeaconChain:
         chain.fork_choice = fc
         chain._states_by_block = {}
         chain._advanced_states = {}
+        from .attester_cache import (
+            AttesterCache, BlockTimesCache, EarlyAttesterCache)
+        chain.attester_cache = AttesterCache()
+        chain.early_attester_cache = EarlyAttesterCache()
+        chain.block_times_cache = BlockTimesCache()
+        chain.lc_optimistic_update = None
+        chain.lc_finality_update = None
         head_root = fc.get_head()
         head_state = _post_state_of(head_root)
         if head_state is None:
