@@ -49,18 +49,34 @@ class Slashing:
 
 
 class Slasher:
-    """Whole-plane min/max-span slasher."""
+    """Whole-plane min/max-span slasher.
+
+    ``engine="numpy"`` (default) keeps the span planes as host arrays;
+    ``engine="device"`` keeps them HBM-resident and drains each queue as
+    grouped fused dispatches (:mod:`.device_spans` — SURVEY §7's second
+    TPU workload), with doubles/evidence handled identically host-side.
+    Both engines are cross-checked in tests/test_slasher.py."""
 
     def __init__(self, n_validators: int, history_length: int = 4096,
-                 kv: Optional[KeyValueStore] = None):
+                 kv: Optional[KeyValueStore] = None,
+                 engine: str = "numpy"):
         self.history = history_length
         self.n = n_validators
+        self.engine = engine
         # Spans store (target − e) distances, clamped to u16 like the
         # reference chunks (`array.rs` MIN_SPAN/MAX_SPAN encodings).
-        self.min_span = np.full((n_validators, history_length), _NO_SPAN_MIN,
-                                np.uint16)
-        self.max_span = np.full((n_validators, history_length), _NO_SPAN_MAX,
-                                np.uint16)
+        if engine == "device":
+            from .device_spans import DeviceSpanPlane
+            self.device_plane = DeviceSpanPlane(n_validators,
+                                                history=history_length)
+            self.min_span = None
+            self.max_span = None
+        else:
+            self.device_plane = None
+            self.min_span = np.full((n_validators, history_length),
+                                    _NO_SPAN_MIN, np.uint16)
+            self.max_span = np.full((n_validators, history_length),
+                                    _NO_SPAN_MAX, np.uint16)
         # (validator, target) → AttesterRecord for double votes + evidence.
         self.by_target: Dict[Tuple[int, int], AttesterRecord] = {}
         self.kv = kv or MemoryStore()
@@ -74,11 +90,83 @@ class Slasher:
 
     def process_queued(self, current_epoch: int) -> List[Slashing]:
         """Drain the queue — one vectorized span update per attestation
-        (the reference's per-chunk batch `update()` grid)."""
+        (numpy engine), or grouped fused device dispatches with the
+        surround gathers coming back from the same dispatch (device
+        engine)."""
+        if self.engine == "device":
+            return self._process_queued_device(current_epoch)
         out: List[Slashing] = []
         for indexed in self.queue:
             out.extend(self._process_one(indexed, current_epoch))
         self.queue = []
+        return out
+
+    def _process_queued_device(self, current_epoch: int) -> List[Slashing]:
+        out: List[Slashing] = []
+        live_atts = []
+        for indexed in self.queue:
+            data = indexed.data
+            s = int(data.source.epoch)
+            t = int(data.target.epoch)
+            if t < s or t > current_epoch or \
+                    current_epoch - t >= self.history or \
+                    t - s > min(self.history, 0xFFFE):
+                continue
+            data_root = data.tree_hash_root()
+            idx = np.asarray([int(i) for i in indexed.attesting_indices],
+                             dtype=np.int64)
+            idx = idx[idx < self.n]
+            # Doubles first, recording IMMEDIATELY so later atts in the
+            # SAME batch see earlier ones (matches the numpy engine's
+            # sequential semantics).
+            live = []
+            rec = AttesterRecord(s, t, data_root, indexed)
+            for v in idx:
+                prev = self.by_target.get((int(v), t))
+                if prev is not None and prev.data_root != data_root:
+                    out.append(Slashing("double", int(v), prev.indexed,
+                                        indexed))
+                else:
+                    live.append(int(v))
+                    self.by_target[(int(v), t)] = rec
+            if live:
+                live_atts.append((s, t, np.asarray(live, np.int64),
+                                  indexed, data_root))
+        self.queue = []
+        if not live_atts:
+            return out
+        groups = self.device_plane.group(
+            [(s, t, idx) for s, t, idx, _a, _r in live_atts])
+        pre = self.device_plane.ingest(groups)
+        for s, t, live, indexed, data_root in live_atts:
+            g_min, g_max = pre[(s, t)]
+            dist = t - s
+            # Pre-batch plane gathers can't see SAME-batch attestations
+            # (ingest is one fused dispatch); fold those in by a pairwise
+            # group sweep — G is a handful per batch, so this is cheap
+            # (the numpy engine gets this for free by updating spans
+            # sequentially).
+            surrounds = g_max[live].astype(np.int64) > dist
+            surrounded = g_min[live].astype(np.int64) < dist
+            batch_sur = np.zeros(live.shape, bool)
+            batch_subd = np.zeros(live.shape, bool)
+            for s2, t2, live2, _a2, _r2 in live_atts:
+                if s2 < s and t2 > t:
+                    batch_sur |= np.isin(live, live2)
+                if s2 > s and t2 < t:
+                    batch_subd |= np.isin(live, live2)
+            surrounds |= batch_sur
+            surrounded |= batch_subd
+            for v in live[surrounds]:
+                prior = self._find_surrounding(int(v), s, t)
+                if prior is not None:
+                    out.append(Slashing("surrounds", int(v),
+                                        prior.indexed, indexed))
+            for v in live[surrounded]:
+                prior = self._find_surrounded(int(v), s, t)
+                if prior is not None:
+                    out.append(Slashing("surrounded", int(v), indexed,
+                                        prior.indexed))
         return out
 
     def _process_one(self, indexed, current_epoch: int) -> List[Slashing]:
