@@ -486,6 +486,56 @@ class HttpApiServer:
             h._json({})
         elif path.startswith("/eth/v1/beacon/pool/"):
             self._pool_submit(h, path, body)
+        elif path.startswith("/eth/v1/beacon/rewards/attestations/"):
+            # Per-validator attestation rewards for an epoch (`http_api`
+            # attestation-rewards route): the same per-component deltas
+            # the EF rewards runner pins, filtered to the requested
+            # validator indices (empty body = all).
+            try:
+                epoch = int(path.split("/")[-1])
+                want = json.loads(body) if body else []
+                want = [int(x) for x in want]
+            except (ValueError, TypeError) as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            state = chain.head.state
+            spe = chain.preset.SLOTS_PER_EPOCH
+            head_epoch = int(state.slot) // spe
+            # Deltas read the PREVIOUS epoch's participation: the state
+            # must sit in epoch + 1.
+            if epoch != head_epoch - 1:
+                h._json({"code": 400, "message":
+                         f"rewards available for epoch {head_epoch - 1} "
+                         "only (head participation window)"}, 400)
+                return
+            from ..types.chain_spec import ForkName
+            fork = chain.spec.fork_name_at_epoch(head_epoch)
+            if fork == ForkName.PHASE0:
+                from ..state_transition.per_epoch_phase0 import (
+                    attestation_deltas_phase0)
+                deltas = attestation_deltas_phase0(state, chain.preset,
+                                                   chain.spec)
+            else:
+                from ..state_transition.per_epoch import flag_deltas
+                deltas = flag_deltas(state, fork, chain.preset,
+                                     chain.spec)
+            indices = want or range(len(state.validators))
+            out = []
+            for i in indices:
+                if not 0 <= int(i) < len(state.validators):
+                    continue
+                i = int(i)
+                row = {"validator_index": str(i)}
+                total = 0
+                for name in ("source", "target", "head"):
+                    r, p = deltas[name]
+                    v = int(r[i]) - int(p[i])
+                    row[name] = str(v)
+                    total += v
+                ir, ip = deltas["inactivity_penalty"]
+                row["inactivity"] = str(int(ir[i]) - int(ip[i]))
+                out.append(row)
+            h._json({"data": {"total_rewards": out}})
         elif path == "/eth/v1/validator/register_validator":
             # Builder registrations (`http_api` register_validator):
             # recorded on the chain (keyed by pubkey, newest timestamp
