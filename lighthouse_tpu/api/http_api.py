@@ -519,19 +519,23 @@ class HttpApiServer:
                 from ..state_transition.per_epoch import flag_deltas
                 deltas = flag_deltas(state, fork, chain.preset,
                                      chain.spec)
-            indices = want or range(len(state.validators))
+            n_vals = len(state.validators)
+            bad = [i for i in want if not 0 <= int(i) < n_vals]
+            if bad:
+                h._json({"code": 400,
+                         "message": f"unknown validator ids {bad}"}, 400)
+                return
+            indices = want or range(n_vals)
             out = []
             for i in indices:
-                if not 0 <= int(i) < len(state.validators):
-                    continue
                 i = int(i)
                 row = {"validator_index": str(i)}
-                total = 0
                 for name in ("source", "target", "head"):
                     r, p = deltas[name]
-                    v = int(r[i]) - int(p[i])
-                    row[name] = str(v)
-                    total += v
+                    row[name] = str(int(r[i]) - int(p[i]))
+                if "inclusion_delay" in deltas:  # phase0 only
+                    ir, ip = deltas["inclusion_delay"]
+                    row["inclusion_delay"] = str(int(ir[i]) - int(ip[i]))
                 ir, ip = deltas["inactivity_penalty"]
                 row["inactivity"] = str(int(ir[i]) - int(ip[i]))
                 out.append(row)

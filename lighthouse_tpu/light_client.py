@@ -116,6 +116,69 @@ class LightClientOptimisticUpdate:
     signature_slot: int
 
 
+def _verify_aggregate_with_committee(committee, genesis_validators_root,
+                                     preset, spec, attested_header,
+                                     sync_aggregate, signature_slot: int,
+                                     min_participants: int) -> bool:
+    """Shared sync-aggregate check: the committee signed the attested
+    header's root under the SYNC_COMMITTEE domain of signature_slot−1's
+    fork (used by both the full-node gossip gate and the light-client
+    store)."""
+    import numpy as np
+
+    from .crypto.bls import PublicKey, Signature, get_backend
+    from .state_transition.helpers import (
+        compute_domain, compute_signing_root)
+    from .types.chain_spec import Domain
+
+    try:
+        bits = np.asarray(sync_aggregate.sync_committee_bits, dtype=bool)
+        if int(bits.sum()) < min_participants:
+            return False
+        sig = Signature.deserialize(
+            sync_aggregate.sync_committee_signature)
+        prev = max(int(signature_slot), 1) - 1
+        epoch = prev // preset.SLOTS_PER_EPOCH
+        fork = spec.fork_name_at_epoch(epoch)
+        domain = compute_domain(Domain.SYNC_COMMITTEE,
+                                spec.fork_version(fork),
+                                bytes(genesis_validators_root))
+        keys = [PublicKey.deserialize(committee.pubkeys[i])
+                for i in np.flatnonzero(bits)]
+        msg = compute_signing_root(attested_header.tree_hash_root(),
+                                   domain)
+        return get_backend().verify(sig, keys, msg)
+    except Exception:
+        return False
+
+
+def verify_update_sync_aggregate(chain, attested_header, sync_aggregate,
+                                 signature_slot: int,
+                                 min_participants: int = 1) -> bool:
+    """Full-node verification of a gossiped LC update
+    (`light_client_{finality,optimistic}_update_verification.rs`): the
+    signing committee is chosen by the signature slot's SYNC-COMMITTEE
+    PERIOD relative to the head's — current committee for the same
+    period, next committee for head period + 1 (a lagging node must not
+    reject updates signed just across the boundary)."""
+    state = chain.head.state
+    preset, spec = chain.preset, chain.spec
+    epochs_per_period = preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    slots_per_period = epochs_per_period * preset.SLOTS_PER_EPOCH
+    head_period = int(state.slot) // slots_per_period
+    sig_period = max(int(signature_slot), 1) // slots_per_period
+    if sig_period == head_period:
+        committee = state.current_sync_committee
+    elif sig_period == head_period + 1:
+        committee = state.next_sync_committee
+    else:
+        return False
+    return _verify_aggregate_with_committee(
+        committee, state.genesis_validators_root, preset, spec,
+        attested_header, sync_aggregate, signature_slot,
+        min_participants)
+
+
 class LightClientServer:
     """Produces light-client artifacts from a chain
     (`beacon_chain/src/light_client_*` production paths)."""
@@ -257,31 +320,12 @@ class LightClientStore:
     def _verify_sync_aggregate(self, attested_header, sync_aggregate,
                                signature_slot: int) -> bool:
         """The committee signed the attested header's root at
-        signature_slot − 1's epoch domain."""
-        import numpy as np
-
-        from .crypto.bls import PublicKey, Signature, get_backend
-        from .state_transition.helpers import (
-            compute_domain, compute_signing_root)
-        from .types.chain_spec import Domain
-
-        bits = np.asarray(sync_aggregate.sync_committee_bits, dtype=bool)
-        if int(bits.sum()) < self.MIN_SYNC_PARTICIPANTS:
-            return False
-        sig = Signature.deserialize(
-            sync_aggregate.sync_committee_signature)
-        prev = max(int(signature_slot), 1) - 1
-        epoch = prev // self.preset.SLOTS_PER_EPOCH
-        fork = self.spec.fork_name_at_epoch(epoch)
-        domain = compute_domain(Domain.SYNC_COMMITTEE,
-                                self.spec.fork_version(fork),
-                                self._genesis_validators_root)
-        keys = [PublicKey.deserialize(
-                    self.current_sync_committee.pubkeys[i])
-                for i in np.flatnonzero(bits)]
-        root = attested_header.tree_hash_root()
-        msg = compute_signing_root(root, domain)
-        return get_backend().verify(sig, keys, msg)
+        signature_slot − 1's epoch domain (shared helper with the
+        full-node gossip gate)."""
+        return _verify_aggregate_with_committee(
+            self.current_sync_committee, self._genesis_validators_root,
+            self.preset, self.spec, attested_header, sync_aggregate,
+            signature_slot, self.MIN_SYNC_PARTICIPANTS)
 
     def process_optimistic_update(
             self, update: LightClientOptimisticUpdate) -> bool:

@@ -27,6 +27,8 @@ TOPIC_EXIT = "voluntary_exit"
 TOPIC_PROPOSER_SLASHING = "proposer_slashing"
 TOPIC_ATTESTER_SLASHING = "attester_slashing"
 TOPIC_SYNC_COMMITTEE = "sync_committee_message"
+TOPIC_LC_OPTIMISTIC = "light_client_optimistic_update"
+TOPIC_LC_FINALITY = "light_client_finality_update"
 ATTESTATION_SUBNET_COUNT = 64
 
 
@@ -74,6 +76,12 @@ class NetworkNode:
         bus.subscribe(TOPIC_BLOCK, self._block_handler)
         self._att_handler = self._on_gossip_attestation
         bus.subscribe(TOPIC_AGGREGATE, self._att_handler)
+        self._last_lc_opt = None
+        self._last_lc_fin = None
+        self._lc_opt_handler = self._on_gossip_lc_optimistic
+        bus.subscribe(TOPIC_LC_OPTIMISTIC, self._lc_opt_handler)
+        self._lc_fin_handler = self._on_gossip_lc_finality
+        bus.subscribe(TOPIC_LC_FINALITY, self._lc_fin_handler)
         # Attestation subnets this node processes (`attestation_service
         # .rs` subscriptions: aggregation duties + persistent subnets).
         self.subnets: set[int] = set()
@@ -104,6 +112,47 @@ class NetworkNode:
         self.bus.publish(TOPIC_SYNC_COMMITTEE, msg,
                          exclude=self._sync_handler)
         self._on_gossip_sync_messages(msg)
+
+    def _publish_lc_updates(self) -> None:
+        """Gossip the LC updates the import just produced
+        (`light_client_finality_update_verification.rs` topics)."""
+        upd = getattr(self.chain, "lc_optimistic_update", None)
+        if upd is not None and upd is not self._last_lc_opt:
+            self._last_lc_opt = upd
+            self.bus.publish(TOPIC_LC_OPTIMISTIC, upd,
+                             exclude=self._lc_opt_handler)
+        fin = getattr(self.chain, "lc_finality_update", None)
+        if fin is not None and fin is not self._last_lc_fin:
+            self._last_lc_fin = fin
+            self.bus.publish(TOPIC_LC_FINALITY, fin,
+                             exclude=self._lc_fin_handler)
+
+    def _on_gossip_lc_optimistic(self, upd) -> None:
+        """Adopt a gossiped optimistic update after verifying its sync
+        aggregate against OUR head committee
+        (`light_client_optimistic_update_verification.rs`)."""
+        from ..light_client import verify_update_sync_aggregate
+        cur = getattr(self.chain, "lc_optimistic_update", None)
+        if cur is not None and int(upd.attested_header.slot) <= \
+                int(cur.attested_header.slot):
+            return
+        if verify_update_sync_aggregate(
+                self.chain, upd.attested_header, upd.sync_aggregate,
+                int(upd.signature_slot)):
+            self.chain.lc_optimistic_update = upd
+            self._last_lc_opt = upd
+
+    def _on_gossip_lc_finality(self, upd) -> None:
+        from ..light_client import verify_update_sync_aggregate
+        cur = getattr(self.chain, "lc_finality_update", None)
+        if cur is not None and int(upd.attested_header.slot) <= \
+                int(cur.attested_header.slot):
+            return
+        if verify_update_sync_aggregate(
+                self.chain, upd.attested_header, upd.sync_aggregate,
+                int(upd.signature_slot)):
+            self.chain.lc_finality_update = upd
+            self._last_lc_fin = upd
 
     def _on_gossip_sync_messages(self, msg) -> None:
         slot, block_root, votes = msg
@@ -165,6 +214,10 @@ class NetworkNode:
         except BlockError as e:
             self.log.warn("block rejected", slot=slot,
                           reason=type(e).__name__)
+        finally:
+            # Whatever path imported blocks (direct, parent lookup, range
+            # sync), publish any LC updates the chain produced.
+            self._publish_lc_updates()
 
     def _process_attestation_batch(self, atts: List) -> None:
         self.chain.process_attestation_batch(atts)

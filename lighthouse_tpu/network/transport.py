@@ -46,6 +46,8 @@ from .service import (
     NetworkNode,
     TOPIC_AGGREGATE,
     TOPIC_BLOCK,
+    TOPIC_LC_FINALITY,
+    TOPIC_LC_OPTIMISTIC,
     TOPIC_SYNC_COMMITTEE,
 )
 
@@ -124,6 +126,56 @@ def _dec_sync(data: bytes):
         votes.append((positions, data[off:off + 96]))
         off += 96
     return (slot, root, votes)
+
+
+def _enc_lc_optimistic(T, upd) -> bytes:
+    hdr = T.BeaconBlockHeader.serialize(upd.attested_header)
+    agg = T.SyncAggregate.serialize(upd.sync_aggregate)
+    return struct.pack("<HH", len(hdr), len(agg)) + hdr + agg + \
+        struct.pack("<Q", int(upd.signature_slot))
+
+
+def _dec_lc_optimistic(T, data: bytes):
+    from ..light_client import LightClientOptimisticUpdate
+    hl, al = struct.unpack_from("<HH", data, 0)
+    off = 4
+    hdr = T.BeaconBlockHeader.deserialize(data[off:off + hl])
+    off += hl
+    agg = T.SyncAggregate.deserialize(data[off:off + al])
+    off += al
+    (slot,) = struct.unpack_from("<Q", data, off)
+    return LightClientOptimisticUpdate(
+        attested_header=hdr, sync_aggregate=agg, signature_slot=slot)
+
+
+def _enc_lc_finality(T, upd) -> bytes:
+    a = T.BeaconBlockHeader.serialize(upd.attested_header)
+    f = T.BeaconBlockHeader.serialize(upd.finalized_header)
+    g = T.SyncAggregate.serialize(upd.sync_aggregate)
+    return (struct.pack("<HHHB", len(a), len(f), len(g),
+                        len(upd.finality_branch))
+            + a + f + g + b"".join(bytes(b) for b in upd.finality_branch)
+            + struct.pack("<QQ", int(upd.signature_slot),
+                          int(upd.finalized_checkpoint_epoch)))
+
+
+def _dec_lc_finality(T, data: bytes):
+    from ..light_client import LightClientFinalityUpdate
+    al, fl, gl, nb = struct.unpack_from("<HHHB", data, 0)
+    off = 7
+    attested = T.BeaconBlockHeader.deserialize(data[off:off + al])
+    off += al
+    finalized = T.BeaconBlockHeader.deserialize(data[off:off + fl])
+    off += fl
+    agg = T.SyncAggregate.deserialize(data[off:off + gl])
+    off += gl
+    branch = [data[off + 32 * i:off + 32 * (i + 1)] for i in range(nb)]
+    off += 32 * nb
+    slot, cp_epoch = struct.unpack_from("<QQ", data, off)
+    return LightClientFinalityUpdate(
+        attested_header=attested, finalized_header=finalized,
+        finality_branch=branch, sync_aggregate=agg, signature_slot=slot,
+        finalized_checkpoint_epoch=cp_epoch)
 
 
 def _enc_atts(T, atts: List) -> bytes:
@@ -381,6 +433,14 @@ class WireNetwork:
         self.bus.subscribe(
             TOPIC_SYNC_COMMITTEE,
             lambda msg: self._flood(TOPIC_SYNC_COMMITTEE, _enc_sync(msg)))
+        self.bus.subscribe(
+            TOPIC_LC_OPTIMISTIC,
+            lambda upd: self._flood(TOPIC_LC_OPTIMISTIC,
+                                    _enc_lc_optimistic(self.T, upd)))
+        self.bus.subscribe(
+            TOPIC_LC_FINALITY,
+            lambda upd: self._flood(TOPIC_LC_FINALITY,
+                                    _enc_lc_finality(self.T, upd)))
         for subnet in range(ATTESTATION_SUBNET_COUNT):
             topic = TOPIC_ATTESTATION_SUBNET.format(subnet)
             self.bus.subscribe(
@@ -511,7 +571,8 @@ class WireNetwork:
     # -- gossipsub mesh maintenance ------------------------------------------
 
     def _mesh_topics(self) -> List[str]:
-        topics = [TOPIC_BLOCK, TOPIC_AGGREGATE, TOPIC_SYNC_COMMITTEE]
+        topics = [TOPIC_BLOCK, TOPIC_AGGREGATE, TOPIC_SYNC_COMMITTEE,
+                  TOPIC_LC_OPTIMISTIC, TOPIC_LC_FINALITY]
         from .service import TOPIC_ATTESTATION_SUBNET
         topics += [TOPIC_ATTESTATION_SUBNET.format(s)
                    for s in self.node.subnets]
@@ -647,6 +708,14 @@ class WireNetwork:
                     obj = _dec_sync(body)
                     deliver = lambda: self.node._on_gossip_sync_messages(
                         obj)
+                elif topic == TOPIC_LC_OPTIMISTIC:
+                    obj = _dec_lc_optimistic(self.T, body)
+                    deliver = lambda: self.node._on_gossip_lc_optimistic(
+                        obj)
+                elif topic == TOPIC_LC_FINALITY:
+                    obj = _dec_lc_finality(self.T, body)
+                    deliver = lambda: self.node._on_gossip_lc_finality(
+                        obj)
                 elif topic.startswith("beacon_attestation_"):
                     # Forward decodable subnet traffic; deliver only
                     # subscribed subnets.
@@ -681,7 +750,8 @@ class WireNetwork:
             from .service import TOPIC_ATTESTATION_SUBNET, \
                 ATTESTATION_SUBNET_COUNT
             known = (topic in (TOPIC_BLOCK, TOPIC_AGGREGATE,
-                               TOPIC_SYNC_COMMITTEE)
+                               TOPIC_SYNC_COMMITTEE, TOPIC_LC_OPTIMISTIC,
+                               TOPIC_LC_FINALITY)
                      or topic in {TOPIC_ATTESTATION_SUBNET.format(s)
                                   for s in range(ATTESTATION_SUBNET_COUNT)})
             if not known:

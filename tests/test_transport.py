@@ -209,3 +209,32 @@ def test_slow_peer_evicted_on_send_queue_overflow(monkeypatch):
         sock.close()
     finally:
         net.close()
+
+
+def test_light_client_updates_cross_the_wire():
+    """A block import with a live sync aggregate produces LC updates that
+    gossip over TCP and get adopted (verified) by the peer."""
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    a = _node(h)
+    b = _node(h)
+    try:
+        peer = a.dial(b.port)
+        peer.head_slot()
+        # Genesis has no stored block, so the FIRST import can't resolve
+        # a parent header for the attested header — use the second.
+        for sync in (0.0, 1.0):
+            sb = h.build_block(sync_participation=sync)
+            h.apply_block(sb)
+            a.node.chain.per_slot_task(int(sb.message.slot))
+            a.node._process_block(sb)
+        assert a.node.chain.lc_optimistic_update is not None
+        assert _wait(lambda: getattr(
+            b.node.chain, "lc_optimistic_update", None) is not None)
+        got = b.node.chain.lc_optimistic_update
+        want = a.node.chain.lc_optimistic_update
+        assert got.attested_header.tree_hash_root() == \
+            want.attested_header.tree_hash_root()
+        assert int(got.signature_slot) == int(want.signature_slot)
+    finally:
+        a.close()
+        b.close()
