@@ -166,8 +166,14 @@ def cmd_beacon_node(args) -> int:
                   f"token={km.token}")
     # Graceful-shutdown service (`environment`'s shutdown-signal task +
     # `beacon_chain` persist-on-drop): SIGTERM must reach the persist
-    # path below, not kill the process mid-write.
+    # path below, not kill the process mid-write.  Service threads run
+    # under the TaskExecutor so shutdown signals, joins, and reports
+    # stragglers (`common/task_executor` role).
     import signal
+
+    from .common.task_executor import TaskExecutor
+
+    executor = TaskExecutor()
 
     def _term(_sig, _frm):
         raise SystemExit(0)
@@ -175,6 +181,20 @@ def cmd_beacon_node(args) -> int:
         signal.signal(signal.SIGTERM, _term)
     except ValueError:
         pass  # non-main thread (embedded use) — rely on finally
+
+    # 3/4-slot state-advance timer as a managed service thread
+    # (`state_advance_timer.rs` spawn).
+    def _advance_timer(stop):
+        fired = -1
+        while not stop.wait(0.1):
+            try:
+                s_now = clock.now()
+                if clock.slot_progress() >= 0.75 and fired < s_now:
+                    fired = s_now
+                    chain.on_three_quarters_slot(s_now)
+            except Exception:
+                pass
+
     # Devnet clock: start at the next slot AFTER the (possibly resumed)
     # head — restarting at slot 0 against a resumed head would have the VC
     # proposing slot-1 blocks onto a later state.
@@ -185,7 +205,7 @@ def cmd_beacon_node(args) -> int:
     last = chain.head.slot
     try:
         deadline = (time.time() + args.run_for) if args.run_for else None
-        fired_3q = -1
+        executor.spawn(_advance_timer, "state_advance_timer")
         while deadline is None or time.time() < deadline:
             slot = clock.now()
             if slot > last:
@@ -195,15 +215,13 @@ def cmd_beacon_node(args) -> int:
                     vc.on_slot(slot)
                 print(f"slot {slot} head={chain.head.root.hex()[:12]} "
                       f"(slot {chain.head.slot})")
-            # 3/4-slot state-advance timer (`state_advance_timer.rs`):
-            # pre-advance + prime attester caches for the NEXT slot.
-            if clock.slot_progress() >= 0.75 and fired_3q < slot:
-                fired_3q = slot
-                chain.on_three_quarters_slot(slot)
             time.sleep(0.1)
     except KeyboardInterrupt:
         pass
     finally:
+        stragglers = executor.shutdown(timeout=3)
+        if stragglers:
+            print(f"warning: tasks did not stop: {stragglers}")
         if args.datadir:
             chain.persist()  # graceful-shutdown persistence
     if km is not None:
