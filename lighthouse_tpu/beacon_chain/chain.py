@@ -266,18 +266,24 @@ class BeaconChain:
 
     def _advance_and_prime(self, target_slot: int) -> None:
         """Pre-advance the head state to ``target_slot`` (memoised) and
-        prime the attester cache for its epoch while the state is hot."""
-        key = (self.head.root, target_slot)
+        prime the attester cache for its epoch while the state is hot.
+
+        Reads ``self.head`` ONCE: CanonicalHead is an immutable snapshot,
+        so a concurrent head swap (the timer runs on its own thread in
+        the real-time node) can at worst waste this advance — it can
+        never mix the new head's root with the old head's state."""
+        head = self.head
+        key = (head.root, target_slot)
         if key in self._advanced_states:
             return
         try:
-            advanced = process_slots(self.head.state.copy(), target_slot,
+            advanced = process_slots(head.state.copy(), target_slot,
                                      self.preset, self.spec, self.T)
         except Exception:
             return  # advance failure must never kill the timer tick
         self._bound_advanced_states()
         self._advanced_states[key] = advanced
-        self.attester_cache.prime_from_state(self.head.root, advanced,
+        self.attester_cache.prime_from_state(head.root, advanced,
                                              self.preset)
 
     def on_three_quarters_slot(self, slot: int) -> None:

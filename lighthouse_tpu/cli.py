@@ -186,14 +186,21 @@ def cmd_beacon_node(args) -> int:
     # (`state_advance_timer.rs` spawn).
     def _advance_timer(stop):
         fired = -1
+        consecutive_failures = 0
         while not stop.wait(0.1):
             try:
                 s_now = clock.now()
                 if clock.slot_progress() >= 0.75 and fired < s_now:
                     fired = s_now
                     chain.on_three_quarters_slot(s_now)
-            except Exception:
-                pass
+                consecutive_failures = 0
+            except Exception as e:
+                # transient failures are tolerated; a persistent one
+                # surfaces through the executor's died-task report
+                consecutive_failures += 1
+                print(f"state-advance timer error: {e!r}")
+                if consecutive_failures >= 3:
+                    raise
 
     # Devnet clock: start at the next slot AFTER the (possibly resumed)
     # head — restarting at slot 0 against a resumed head would have the VC

@@ -137,17 +137,23 @@ class Slasher:
             return out
         groups = self.device_plane.group(
             [(s, t, idx) for s, t, idx, _a, _r in live_atts])
+        group_members = {(s, t): idx for s, t, idx in groups}
         pre = self.device_plane.ingest(groups)
         for s, t, live, indexed, data_root in live_atts:
-            g_min, g_max = pre[(s, t)]
+            gm_vals, gx_vals = pre[(s, t)]
+            members = group_members[(s, t)]  # sorted unique indices
+            # positional lookup: this att's validators within the group
+            pos = np.searchsorted(members, live)
+            g_min = gm_vals[pos]
+            g_max = gx_vals[pos]
             dist = t - s
             # Pre-batch plane gathers can't see SAME-batch attestations
             # (ingest is one fused dispatch); fold those in by a pairwise
             # group sweep — G is a handful per batch, so this is cheap
             # (the numpy engine gets this for free by updating spans
             # sequentially).
-            surrounds = g_max[live].astype(np.int64) > dist
-            surrounded = g_min[live].astype(np.int64) < dist
+            surrounds = g_max.astype(np.int64) > dist
+            surrounded = g_min.astype(np.int64) < dist
             batch_sur = np.zeros(live.shape, bool)
             batch_subd = np.zeros(live.shape, bool)
             for s2, t2, live2, _a2, _r2 in live_atts:

@@ -51,23 +51,33 @@ from ..ops.merkle import _next_pow2  # noqa: E402 (shared helper)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
-def _ingest_kernel(min_plane, max_plane, masks, sources, targets, live):
+def _ingest_kernel(min_plane, max_plane, masks_packed, sources, targets,
+                   live, group_idx):
     """One fused ingest: scan G groups of full-plane masked sweeps.
 
     min_plane/max_plane: (n, H) uint16 ring buffers (column = epoch % H)
-    masks:   (G, n) bool — group membership per validator
+    masks_packed: (G, n/8) uint8 — BIT-PACKED group membership (the
+        tunnel is bandwidth-bound; the packed form is 8× smaller and is
+        unpacked on device)
     sources: (G,) int32, targets: (G,) int32 (absolute epochs; −1 = pad)
     live:    (G,) bool — group is real
+    group_idx: (G, W) int32 — each group's member validator indices,
+        zero-padded; the surround gathers return ONLY these positions
+        (pulling full (n,) columns back dwarfed the sweep at registry
+        scale)
 
-    Returns updated planes + (G, n) gathers of min/max at each group's
-    source column (pre-update values, for surround detection).
+    Returns updated planes + (G, W) pre-update min/max gathers at each
+    group's source column.
     """
     n, H = min_plane.shape
     cols = jnp.arange(H, dtype=jnp.int32)  # column index = epoch % H
 
     def body(planes, group):
         mn, mx = planes
-        mask, s, t, ok = group
+        packed, s, t, ok, gidx = group
+        # unpack bits (bitorder='little' matches np.packbits host-side)
+        mask = ((packed[:, None] >> jnp.arange(8, dtype=jnp.uint8))
+                & 1).astype(bool).reshape(-1)[:n]
         # Mirror the host sweeps exactly (slasher/__init__.py):
         #   min: e ∈ [max(s−H+1, 0), s)  → min_span[e%H] = min(., t−e)
         #   max: e ∈ (s, t)              → max_span[e%H] = max(., t−e)
@@ -87,14 +97,18 @@ def _ingest_kernel(min_plane, max_plane, masks, sources, targets, live):
                            jnp.minimum(mn, v1[None, :]), mn)
         mx_new = jnp.where(m2 & max_cols[None, :],
                            jnp.maximum(mx, v2[None, :]), mx)
-        # pre-update gathers at the source column (for surround checks)
+        # pre-update gathers at the source column, at the group's own
+        # member indices only
         sc = (s % H).astype(jnp.int32)
-        g_min = lax.dynamic_index_in_dim(mn, sc, axis=1, keepdims=False)
-        g_max = lax.dynamic_index_in_dim(mx, sc, axis=1, keepdims=False)
-        return (mn_new, mx_new), (g_min, g_max)
+        col_min = lax.dynamic_index_in_dim(mn, sc, axis=1,
+                                           keepdims=False)
+        col_max = lax.dynamic_index_in_dim(mx, sc, axis=1,
+                                           keepdims=False)
+        return (mn_new, mx_new), (col_min[gidx], col_max[gidx])
 
     (mn, mx), (g_min, g_max) = lax.scan(
-        body, (min_plane, max_plane), (masks, sources, targets, live))
+        body, (min_plane, max_plane),
+        (masks_packed, sources, targets, live, group_idx))
     return mn, mx, g_min, g_max
 
 
@@ -123,9 +137,9 @@ class DeviceSpanPlane:
     def ingest(self, groups: Sequence[Tuple[int, int, np.ndarray]]):
         """Apply grouped updates in fused dispatches of ≤ _MAX_GROUPS.
 
-        Returns one dict (s, t) → ((n,) pre-update min gather, (n,)
-        pre-update max gather) at the source column, for surround
-        detection on the host.
+        Returns one dict (s, t) → (min gather, max gather) at the
+        source column, ALIGNED WITH the group's (sorted, unique) member
+        index array — positional, not validator-indexed.
 
         Contract: exact equality with the host Slasher's numpy sweeps
         holds for t − s ≤ min(history, 0xFFFE) — beyond that the ring
@@ -143,23 +157,27 @@ class DeviceSpanPlane:
         for at in range(0, len(groups), _MAX_GROUPS):
             chunk = groups[at:at + _MAX_GROUPS]
             G = _next_pow2(len(chunk))
+            W = _next_pow2(max(len(idx) for _s, _t, idx in chunk))
             masks = np.zeros((G, self.n), bool)
             sources = np.full(G, -1, np.int32)
             targets = np.full(G, -1, np.int32)
             live = np.zeros(G, bool)
+            gidx = np.zeros((G, W), np.int32)
             for i, (s, t, idx) in enumerate(chunk):
                 masks[i, idx] = True
                 sources[i] = s
                 targets[i] = t
                 live[i] = True
+                gidx[i, :len(idx)] = idx
+            packed = np.packbits(masks, axis=1, bitorder="little")
             self.min_plane, self.max_plane, g_min, g_max = _ingest_kernel(
-                self.min_plane, self.max_plane, jnp.asarray(masks),
+                self.min_plane, self.max_plane, jnp.asarray(packed),
                 jnp.asarray(sources), jnp.asarray(targets),
-                jnp.asarray(live))
+                jnp.asarray(live), jnp.asarray(gidx))
             g_min = np.asarray(g_min)
             g_max = np.asarray(g_max)
-            for i, (s, t, _) in enumerate(chunk):
-                pre[(s, t)] = (g_min[i], g_max[i])
+            for i, (s, t, idx) in enumerate(chunk):
+                pre[(s, t)] = (g_min[i, :len(idx)], g_max[i, :len(idx)])
         return pre
 
     def to_host(self) -> Tuple[np.ndarray, np.ndarray]:

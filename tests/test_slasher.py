@@ -147,10 +147,11 @@ def test_device_span_plane_matches_host():
     mn, mx = dev.to_host()
     assert (mn == host.min_span).all()
     assert (mx == host.max_span).all()
-    # pre-update gathers exist for every group and have plane width
+    # pre-update gathers exist per group, aligned with its member list
     assert set(pre) == {(s, t) for s, t, _ in groups}
-    for (s, t), (gmin, gmax) in pre.items():
-        assert gmin.shape == (n,) and gmax.shape == (n,)
+    for (s, t, idx) in groups:
+        gmin, gmax = pre[(s, t)]
+        assert gmin.shape == (len(idx),) and gmax.shape == (len(idx),)
 
 
 def test_device_span_gathers_enable_surround_detection():
@@ -163,13 +164,12 @@ def test_device_span_gathers_enable_surround_detection():
     dev = DeviceSpanPlane(n, history=H)
     # att A: validator 5, (s=2, t=10) — writes max_span cols for e in (2,10)
     dev.ingest(dev.group([(2, 10, np.array([5]))]))
-    # att B: validator 5, (s=4, t=6): A surrounds B
-    pre = dev.ingest(dev.group([(4, 6, np.array([5]))]))
-    gmin, gmax = pre[(4, 6)]
+    # att B: validators 5 and 6, (s=4, t=6): A surrounds B for 5 only
+    pre = dev.ingest(dev.group([(4, 6, np.array([5, 6]))]))
+    gmin, gmax = pre[(4, 6)]            # positional: [v5, v6]
     dist = 6 - 4
-    assert int(gmax[5]) > dist          # surrounded by A
-    # a fresh validator shows no surround
-    assert int(gmax[6]) == 0
+    assert int(gmax[0]) > dist          # v5 surrounded by A
+    assert int(gmax[1]) == 0            # v6 fresh: no surround
 
 
 def test_device_engine_matches_numpy_engine():
