@@ -179,16 +179,22 @@ def _gen_epoch_processing(root: str, fork: ForkName) -> None:
     target = (int(state.slot) // spe + 1) * spe - 1
     if int(state.slot) < target:
         state = process_slots(state, target, h.preset, h.spec, h.T)
+    # second starting point: an INACTIVITY-LEAK state (5 empty epochs
+    # stall finality), exercising the leak arms of justification,
+    # rewards, and inactivity updates.
+    leak = process_slots(h.state.copy(), int(h.state.slot) + 5 * spe - 1,
+                         h.preset, h.spec, h.T)
     steps = _epoch_steps(fork, h.preset, h.spec, h.T)
-    cur = state
-    for handler, fn in steps.items():
-        d = _case(root, "minimal", fork, "epoch_processing", handler,
-                  "pyspec_tests", "from_chain")
-        _dump_state(d, "pre", cur)
-        nxt = cur.copy()
-        fn(nxt)
-        _dump_state(d, "post", nxt)
-        cur = nxt  # EF semantics: each step's pre has prior steps applied
+    for case, start in (("from_chain", state), ("leak", leak)):
+        cur = start
+        for handler, fn in steps.items():
+            d = _case(root, "minimal", fork, "epoch_processing", handler,
+                      "pyspec_tests", case)
+            _dump_state(d, "pre", cur)
+            nxt = cur.copy()
+            fn(nxt)
+            _dump_state(d, "post", nxt)
+            cur = nxt  # EF semantics: each step's pre has priors applied
 
 
 def _gen_ssz_static(root: str, fork: ForkName) -> None:
