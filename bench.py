@@ -329,16 +329,20 @@ def _slasher_bench() -> dict:
                              history=512, per_att=256)
 
 
-# (name, fn, emitted-metric-name).  Headline FIRST so a budget/timeout
-# still captures the row that matters most.
+# (name, fn, emitted-metric-name).  FAST rows first: the BLS row pays a
+# ~15-20 min per-process TRACE before it can answer (lax.scan pairing
+# graphs on one python core), so under an unknown driver timeout the
+# cheap rows must already be on the tail; the combined line re-emits
+# after every row so the LAST captured line is always a full record of
+# everything measured so far.
 _ROWS = [
-    ("bls", _bls_bench, "bls_batch_verify_%d_sets" % N_SETS),
     ("registry", _registry_htr_bench, "registry_htr_2e%d" % REG_LOG2),
     ("state_root", _incremental_state_root_bench,
      "state_root_2e%d" % STATE_LOG2),
-    ("block", _block_transition_bench, "block_transition_128att"),
     ("op_pool", _op_pool_bench, "op_pool_pack_100k"),
     ("slasher", _slasher_bench, "slasher_span_update_1m"),
+    ("block", _block_transition_bench, "block_transition_128att"),
+    ("bls", _bls_bench, "bls_batch_verify_%d_sets" % N_SETS),
     ("stages", _stage_split_bench, "bls_stage_split"),
 ]
 
@@ -384,7 +388,18 @@ def main() -> None:
         merged.update(row)
         _emit({"metric": metric, "row_s": round(time.monotonic() - t0, 1),
                **row})
+        combined = _combined(merged, skipped)
+        _emit(combined)  # tail capture always ends on a full record
+        try:  # supplementary snapshot for post-hoc inspection
+            with open("BENCH_LATEST.json", "w") as f:
+                json.dump(combined, f)
+        except OSError:
+            pass
 
+    print(json.dumps(_combined(merged, skipped)))
+
+
+def _combined(merged: dict, skipped: list) -> dict:
     bls_row = {}
     if "sets_per_s" in merged:
         bls_row = {
@@ -406,7 +421,7 @@ def main() -> None:
             "valid batch accepted, tampered batch rejected; "
             "device hash-to-curve == host RFC-9380 oracle; "
             "registry root == host-spec root (tested suite)")
-    print(json.dumps(out))
+    return out
 
 
 if __name__ == "__main__":
