@@ -296,6 +296,60 @@ class HttpApiServer:
                     "current_sync_committee_branch":
                         ["0x" + b.hex()
                          for b in bs.current_sync_committee_branch]}})
+        elif path == "/eth/v1/beacon/light_client/updates":
+            # Period-advancing updates (`light_client/updates` route):
+            # serves the CURRENT period's update (this build keeps one
+            # live period; a start_period beyond it 404s).
+            from ..light_client import LightClientServer
+            qs = parse_qs(urlparse(h.path).query)
+            spe = chain.preset.SLOTS_PER_EPOCH
+            period_slots = spe * chain.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            cur_period = chain.head.slot // period_slots
+            try:
+                start = int(qs.get("start_period", [str(cur_period)])[0])
+            except ValueError:
+                h._json({"code": 400, "message": "bad start_period"}, 400)
+                return
+            if start != cur_period:
+                h._json({"code": 404,
+                         "message": f"only period {cur_period} is live"},
+                        404)
+                return
+            fin = chain.lc_finality_update  # snapshot: import thread swaps
+            if fin is None:
+                h._json({"code": 404, "message": "no sync aggregate yet"},
+                        404)
+                return
+            upd = LightClientServer(chain).update(
+                fin.sync_aggregate, int(fin.signature_slot))
+            h._json({"data": [{
+                "attested_header": {"beacon": to_json(upd.attested_header)},
+                "next_sync_committee": to_json(upd.next_sync_committee),
+                "next_sync_committee_branch":
+                    ["0x" + b.hex()
+                     for b in upd.next_sync_committee_branch],
+                "finalized_header": (
+                    {"beacon": to_json(upd.finalized_header)}
+                    if upd.finalized_header is not None else None),
+                "finality_branch": ["0x" + b.hex()
+                                    for b in upd.finality_branch],
+                "sync_aggregate": to_json(upd.sync_aggregate),
+                "signature_slot": str(int(upd.signature_slot))}]})
+        elif path == "/eth/v1/node/peers":
+            net = getattr(chain, "network", None)
+            peers = []
+            if net is not None:
+                pm = net.peer_manager
+                for p in list(net.peers):
+                    pid = getattr(p, "peer_id", None)
+                    peers.append({
+                        "peer_id": (pid.hex() if pid else str(id(p))),
+                        "state": ("disconnected"
+                                  if pm.is_banned(p) else "connected"),
+                        "score": round(pm.score(p), 2),
+                        "direction": "outbound"})
+            h._json({"data": peers,
+                     "meta": {"count": len(peers)}})
         elif path == "/eth/v1/beacon/light_client/optimistic_update":
             upd = chain.lc_optimistic_update
             if upd is None:

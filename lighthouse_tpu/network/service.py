@@ -65,6 +65,7 @@ class NetworkNode:
     def __init__(self, chain: BeaconChain, bus: GossipBus,
                  name: str = "node", log: Optional[Logger] = None):
         self.chain = chain
+        chain.network = self  # the API's /node/peers + gossip introspection
         self.bus = bus
         self.name = name
         self.log = (log or test_logger()).child(name)

@@ -285,3 +285,31 @@ def test_attestation_rewards_route(api):
     deltas = flag_deltas(chain.head.state, fork, h.preset, h.spec)
     r0, p0 = deltas["source"]
     assert int(data[0]["source"]) == int(r0[0]) - int(p0[0])
+
+
+def test_lc_updates_and_peers_routes(api):
+    import json
+    import urllib.error
+    import urllib.request
+    h, chain, srv = api
+    # no network attached: peers empty
+    data = _get(srv, "/eth/v1/node/peers")
+    assert data["data"] == [] and data["meta"]["count"] == 0
+    # build enough chain for a finality update with a sync aggregate
+    for _ in range(5 * h.preset.SLOTS_PER_EPOCH):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb)
+    assert chain.lc_finality_update is not None
+    data = _get(srv, "/eth/v1/beacon/light_client/updates")["data"]
+    assert len(data) == 1
+    upd = data[0]
+    assert "next_sync_committee" in upd
+    assert len(upd["next_sync_committee_branch"]) > 0
+    # out-of-range period 404s
+    try:
+        _get(srv, "/eth/v1/beacon/light_client/updates?start_period=999")
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
