@@ -39,7 +39,9 @@ def test_network_with_hostile_peers_finalizes():
     import threading
     import time
 
-    sim = Simulator(n_nodes=4, n_validators=16)
+    # 6 honest wire nodes + the spammer + the staller = the 8-node
+    # hostile drill from VERDICT r4 #6.
+    sim = Simulator(n_nodes=6, n_validators=24)
     try:
         assert sim.wait_for_mesh()
         target = sim.nodes[0].net
