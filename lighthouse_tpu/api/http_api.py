@@ -335,6 +335,18 @@ class HttpApiServer:
                                     for b in upd.finality_branch],
                 "sync_aggregate": to_json(upd.sync_aggregate),
                 "signature_slot": str(int(upd.signature_slot))}]})
+        elif path == "/eth/v1/node/identity":
+            net = getattr(chain, "network", None)
+            node_id = getattr(net, "node_id", b"") if net else b""
+            port = getattr(net, "port", 0) if net else 0
+            h._json({"data": {
+                "peer_id": node_id.hex() if node_id else "",
+                "enr": "",
+                "p2p_addresses": ([f"/ip4/127.0.0.1/tcp/{port}"]
+                                  if port else []),
+                "discovery_addresses": [],
+                "metadata": {"seq_number": "0", "attnets": "0x" + "00" * 8,
+                             "syncnets": "0x00"}}})
         elif path == "/eth/v1/node/peers":
             net = getattr(chain, "network", None)
             peers = []

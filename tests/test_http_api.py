@@ -313,3 +313,9 @@ def test_lc_updates_and_peers_routes(api):
         assert False, "expected 404"
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_node_identity_route(api):
+    h, chain, srv = api
+    data = _get(srv, "/eth/v1/node/identity")["data"]
+    assert "peer_id" in data and "p2p_addresses" in data
