@@ -339,20 +339,28 @@ class HttpApiServer:
             net = getattr(chain, "network", None)
             node_id = getattr(net, "node_id", b"") if net else b""
             port = getattr(net, "port", 0) if net else 0
+            inner = getattr(net, "node", net)  # WireNetwork wraps the node
+            subnets = getattr(inner, "subnets", set()) or set()
+            attnets = 0
+            for sn in subnets:
+                attnets |= 1 << int(sn)
             h._json({"data": {
                 "peer_id": node_id.hex() if node_id else "",
                 "enr": "",
                 "p2p_addresses": ([f"/ip4/127.0.0.1/tcp/{port}"]
                                   if port else []),
                 "discovery_addresses": [],
-                "metadata": {"seq_number": "0", "attnets": "0x" + "00" * 8,
+                "metadata": {"seq_number": "0",
+                             "attnets": "0x" + attnets.to_bytes(
+                                 8, "little").hex(),
                              "syncnets": "0x00"}}})
         elif path == "/eth/v1/node/peers":
             net = getattr(chain, "network", None)
+            node = getattr(net, "node", net)  # WireNetwork wraps the node
             peers = []
-            if net is not None:
-                pm = net.peer_manager
-                for p in list(net.peers):
+            if node is not None:
+                pm = node.peer_manager
+                for p in list(node.peers):
                     pid = getattr(p, "peer_id", None)
                     peers.append({
                         "peer_id": (pid.hex() if pid else str(id(p))),

@@ -448,6 +448,9 @@ class WireNetwork:
                     _t, _enc_atts(self.T, atts)))
         self._listener = socket.create_server(("127.0.0.1", port))
         self.port = self._listener.getsockname()[1]
+        # The API introspects the outermost network layer: node_id/port
+        # live here, peers/peer_manager on .node (http_api handles both).
+        chain.network = self
         self._accept_t = threading.Thread(target=self._accept_loop,
                                           daemon=True)
         self._accept_t.start()

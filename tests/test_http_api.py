@@ -317,5 +317,19 @@ def test_lc_updates_and_peers_routes(api):
 
 def test_node_identity_route(api):
     h, chain, srv = api
+    # without a network: empty identity
     data = _get(srv, "/eth/v1/node/identity")["data"]
-    assert "peer_id" in data and "p2p_addresses" in data
+    assert data["peer_id"] == "" and data["p2p_addresses"] == []
+    # with a live wire network: real node id, port, and subnets
+    from lighthouse_tpu.network.transport import WireNetwork
+    net = WireNetwork(chain, name="ident")
+    try:
+        net.node.subscribe_subnet(3)
+        data = _get(srv, "/eth/v1/node/identity")["data"]
+        assert data["peer_id"] == net.node_id.hex()
+        assert data["p2p_addresses"] == [f"/ip4/127.0.0.1/tcp/{net.port}"]
+        attnets = int.from_bytes(
+            bytes.fromhex(data["metadata"]["attnets"][2:]), "little")
+        assert attnets & (1 << 3)
+    finally:
+        net.close()
