@@ -295,3 +295,34 @@ def test_verify_operation_gossip_gates():
     same.attestation_2 = same.attestation_1
     with pytest.raises(OpVerificationError, match="not slashable"):
         verify_attester_slashing(chain, same)
+
+
+def test_block_times_cache_bounded():
+    from lighthouse_tpu.beacon_chain.attester_cache import BlockTimesCache
+
+    c = BlockTimesCache()
+    for i in range(c.MAX_ENTRIES + 10):
+        c.observed(i.to_bytes(32, "big"))
+    assert len(c._map) <= c.MAX_ENTRIES
+    # oldest evicted, newest retained
+    assert c.times((0).to_bytes(32, "big")) is None
+    assert c.times((c.MAX_ENTRIES + 9).to_bytes(32, "big")) is not None
+
+
+def test_attester_cache_lru_bound():
+    from lighthouse_tpu.beacon_chain.attester_cache import (
+        AttesterCache, AttesterCacheEntry)
+
+    c = AttesterCache()
+    e = AttesterCacheEntry(source_epoch=0, source_root=b"\x00" * 32,
+                           target_root=b"\x01" * 32)
+    for i in range(c.MAX_ENTRIES + 5):
+        c.put(i.to_bytes(32, "big"), 0, e)
+    assert len(c._map) <= c.MAX_ENTRIES
+    # touching an entry protects it from eviction
+    hot = (c.MAX_ENTRIES + 4).to_bytes(32, "big")
+    assert c.get(hot, 0) is not None
+    for i in range(100, 100 + c.MAX_ENTRIES - 1):
+        c.put(i.to_bytes(32, "big"), 0, e)
+        c.get(hot, 0)
+    assert c.get(hot, 0) is not None

@@ -257,3 +257,47 @@ def test_range_sync_batches_are_epoch_aligned_and_retry_bounded():
         c.tick(node, pm)
     assert c.batches[0].state == BatchState.FAILED
     assert len(c.batches[0].attempts) == MAX_BATCH_ATTEMPTS
+
+
+def test_range_sync_finalized_chains_drain_before_head_chains():
+    """sync_type.rs priority: all FINALIZED chains order before HEAD
+    chains, and within a class, more peers = more credible target."""
+    from lighthouse_tpu.network.range_sync import ChainType, RangeSync
+
+    class _Chain:
+        head = type("H", (), {"slot": 0})()
+        preset = type("P", (), {"SLOTS_PER_EPOCH": 8})()
+
+    class _Node:
+        chain = _Chain()
+
+    rs = RangeSync(_Node())
+    p1, p2, p3 = object(), object(), object()
+    rs.add_peer(p1, b"\x01" * 32, 20, ChainType.HEAD)
+    rs.add_peer(p2, b"\x02" * 32, 24, ChainType.FINALIZED)
+    rs.add_peer(p3, b"\x02" * 32, 24, ChainType.FINALIZED)
+    rs.add_peer(p1, b"\x03" * 32, 28, ChainType.FINALIZED)
+    ordered = rs._ordered()
+    kinds = [c.chain_type for c in ordered]
+    assert kinds == [ChainType.FINALIZED, ChainType.FINALIZED,
+                     ChainType.HEAD]
+    # the 2-peer finalized chain outranks the 1-peer one
+    assert len(ordered[0].peers) == 2
+
+
+def test_rpc_token_bucket_refill():
+    import time
+
+    from lighthouse_tpu.network.transport import _TokenBucket
+
+    b = _TokenBucket(capacity=2.0, refill_per_s=100.0)
+    assert b.allow() and b.allow()
+    assert not b.allow()          # drained
+    time.sleep(0.05)              # ~5 tokens refilled, capped at 2
+    assert b.allow() and b.allow()
+    assert not b.allow()
+    # cost-based spend
+    b2 = _TokenBucket(capacity=10.0, refill_per_s=0.0)
+    assert b2.allow(cost=8.0)
+    assert not b2.allow(cost=8.0)
+    assert b2.allow(cost=2.0)
