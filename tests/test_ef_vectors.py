@@ -83,3 +83,39 @@ def test_ef_vectors_fake_backend_state_handlers(vectors_root):
                      and "files never accessed" not in f
                      and not sig_gated.search(f)]
     assert not state_failures, "\n".join(state_failures)
+
+
+def test_runner_flags_unconsumed_files(vectors_root, tmp_path):
+    """The no-silent-skips gate (check_all_files_accessed.py role): an
+    unknown file anywhere in the tree fails the run."""
+    import shutil
+
+    from lighthouse_tpu.testing import ef_runner
+
+    clone = tmp_path / "tree"
+    shutil.copytree(vectors_root, clone)
+    stray = (clone / "tests" / "minimal" / "phase0" / "sanity" / "slots"
+             / "pyspec_tests" / "slots_1" / "unconsumed.bin")
+    stray.write_bytes(b"\x00")
+    B.set_backend("python")
+    report = ef_runner.run_tree(str(clone))
+    assert not report.ok()
+    assert any("never accessed" in f for f in report.failures)
+
+
+def test_runner_rejects_unknown_runner_dir(vectors_root, tmp_path):
+    import shutil
+
+    from lighthouse_tpu.testing import ef_runner
+
+    clone = tmp_path / "tree"
+    shutil.copytree(vectors_root, clone)
+    bogus = clone / "tests" / "minimal" / "phase0" / "bogus_runner" / "x" \
+        / "suite" / "case"
+    bogus.mkdir(parents=True)
+    (bogus / "data.yaml").write_text("{}")
+    B.set_backend("python")
+    # unknown runner dirs fail LOUDLY (raise at dispatch, before any
+    # case could silently skip)
+    with pytest.raises(ef_runner.EfTestFailure, match="unknown runner"):
+        ef_runner.run_tree(str(clone))

@@ -221,3 +221,37 @@ def test_eth1_polling_service_ingests_logs_over_rpc():
     assert latest.number == 12  # head 20 − follow distance 8
     # idempotent second round: nothing new
     assert poller.update() == 0
+
+
+def test_eth1_data_vote_prefers_fresh_valid_block():
+    """`get_eth1_vote` freshest-valid fallback: a cached block with MORE
+    deposits than the state's eth1_data wins; a stale one (fewer
+    deposits) must not roll the vote back."""
+    from lighthouse_tpu.eth1 import Eth1Block, Eth1Service
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    state = h.state
+    svc = Eth1Service(h.preset, h.spec)
+    base_count = int(state.eth1_data.deposit_count)
+
+    # no cached block: keep the state's vote
+    vote = svc.eth1_data_for_vote(state, h.T)
+    assert bytes(vote.block_hash) == bytes(state.eth1_data.block_hash)
+
+    # stale cached block (fewer deposits): keep the state's vote
+    svc.blocks.insert(Eth1Block(hash=b"\x0a" * 32, number=1, timestamp=1,
+                                deposit_root=b"\x0b" * 32,
+                                deposit_count=max(base_count - 1, 0)))
+    if base_count > 0:
+        vote = svc.eth1_data_for_vote(state, h.T)
+        assert bytes(vote.block_hash) == bytes(state.eth1_data.block_hash)
+
+    # fresh block with more deposits: vote moves forward
+    svc.blocks.insert(Eth1Block(hash=b"\x0c" * 32, number=2, timestamp=2,
+                                deposit_root=b"\x0d" * 32,
+                                deposit_count=base_count + 3))
+    vote = svc.eth1_data_for_vote(state, h.T)
+    assert bytes(vote.block_hash) == b"\x0c" * 32
+    assert int(vote.deposit_count) == base_count + 3
