@@ -153,8 +153,19 @@ class HttpApiServer:
                         return
                     indices = [i for i in indices if 0 <= i < n]
                 else:
-                    offset = int(qs.get("offset", ["0"])[0])
-                    limit = min(int(qs.get("limit", ["1000"])[0]), 10_000)
+                    try:
+                        offset = int(qs.get("offset", ["0"])[0])
+                        limit = min(int(qs.get("limit", ["1000"])[0]),
+                                    10_000)
+                        if offset < 0 or limit < 0:
+                            raise ValueError("negative pagination")
+                    except ValueError:
+                        # same contract as the id-filter branch: malformed
+                        # pagination is a 400, not an unhandled 500 (a
+                        # negative offset would wrap the registry arrays)
+                        h._json({"code": 400,
+                                 "message": "bad offset/limit"}, 400)
+                        return
                     indices = range(offset, min(offset + limit, n))
                 epoch = chain.head.slot // chain.preset.SLOTS_PER_EPOCH
                 act = reg.col("activation_epoch")
@@ -299,8 +310,13 @@ class HttpApiServer:
         elif path == "/eth/v1/beacon/light_client/updates":
             # Period-advancing updates (`light_client/updates` route):
             # serves the CURRENT period's update (this build keeps one
-            # live period; a start_period beyond it 404s).
-            from ..light_client import LightClientServer
+            # live period; a start_period beyond it 404s).  The update is
+            # the full LightClientUpdate cached at block import —
+            # attested_header = the parent header the aggregate actually
+            # signed, branches from the parent state.  (It was once
+            # rebuilt here from the live head, which paired the cached
+            # aggregate with a header it never signed: every
+            # spec-conformant client rejected the signature.)
             qs = parse_qs(urlparse(h.path).query)
             spe = chain.preset.SLOTS_PER_EPOCH
             period_slots = spe * chain.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
@@ -315,13 +331,22 @@ class HttpApiServer:
                          "message": f"only period {cur_period} is live"},
                         404)
                 return
-            fin = chain.lc_finality_update  # snapshot: import thread swaps
-            if fin is None:
+            upd = chain.lc_period_update  # snapshot: import thread swaps
+            if upd is None:
                 h._json({"code": 404, "message": "no sync aggregate yet"},
                         404)
                 return
-            upd = LightClientServer(chain).update(
-                fin.sync_aggregate, int(fin.signature_slot))
+            # an update's period is its ATTESTED header's (the spec keys
+            # committee data off compute_sync_committee_period_at_slot of
+            # the attested slot, not the signature slot)
+            if int(upd.attested_header.slot) // period_slots != start:
+                # head crossed into a new period but no update has been
+                # produced for it yet — don't serve a stale period's
+                # update under the new period's label
+                h._json({"code": 404,
+                         "message": f"no update for period {start} yet"},
+                        404)
+                return
             h._json({"data": [{
                 "attested_header": {"beacon": to_json(upd.attested_header)},
                 "next_sync_committee": to_json(upd.next_sync_committee),

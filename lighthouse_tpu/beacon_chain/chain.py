@@ -127,6 +127,7 @@ class BeaconChain:
         self.block_times_cache = BlockTimesCache()
         self.lc_optimistic_update = None
         self.lc_finality_update = None
+        self.lc_period_update = None
         self.head = CanonicalHead(root=genesis_block_root,
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
@@ -208,6 +209,7 @@ class BeaconChain:
         chain.block_times_cache = BlockTimesCache()
         chain.lc_optimistic_update = None
         chain.lc_finality_update = None
+        chain.lc_period_update = None
         head_root = fc.get_head()
         head_state = _post_state_of(head_root)
         if head_state is None:
@@ -484,7 +486,7 @@ class BeaconChain:
             return  # only blocks extending the head produce updates
         try:
             from ..light_client import LightClientServer
-            opt, fin = LightClientServer(self).updates_for_block(
+            opt, fin, period = LightClientServer(self).updates_for_block(
                 signed_block)
         except Exception:
             return  # LC production is best-effort, never blocks import
@@ -496,6 +498,12 @@ class BeaconChain:
             self.lc_finality_update = fin
             self.event_bus.publish("light_client_finality_update", {
                 "slot": str(int(fin.attested_header.slot))})
+        if period is not None:
+            # Full LightClientUpdate cached at import: served verbatim by
+            # /eth/v1/beacon/light_client/updates (attested header = the
+            # parent header the aggregate signed — never rebuilt from the
+            # live head, which would break the signature).
+            self.lc_period_update = period
 
     def recompute_head(self) -> bytes:
         """`recompute_head` (`canonical_head.rs`)."""

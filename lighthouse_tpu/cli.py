@@ -27,6 +27,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dump-config", default="",
                    help="write the effective chain spec YAML to PATH and "
                         "exit (`clap_utils` --dump-config)")
+    p.add_argument("--compile-cache", default="", metavar="DIR",
+                   help="enable JAX's persistent compilation cache at DIR "
+                        "(default: <repo>/.jax_cache; 'off' disables) so a "
+                        "restarted node never re-pays the cold XLA compile "
+                        "of the device pipelines")
+
+
+def _maybe_enable_compile_cache(args) -> None:
+    flag = getattr(args, "compile_cache", "")
+    if flag == "off":
+        return
+    from .common.compile_cache import enable
+
+    enable(flag or None)
 
 
 def _effective_spec(args):
@@ -42,6 +56,7 @@ def _setup(args):
     from .testing.harness import StateHarness
     from .types.presets import MAINNET, MINIMAL
 
+    _maybe_enable_compile_cache(args)
     bls.set_backend(args.backend if hasattr(args, "backend") else "fake")
     preset = MINIMAL if args.preset == "minimal" else MAINNET
     spec = _effective_spec(args)
@@ -292,6 +307,30 @@ def cmd_account(args) -> int:
     return 1
 
 
+def cmd_warmup(args) -> int:
+    """Pre-compile the device hot paths into the persistent cache
+    (`--compile-cache`), so the next node process pays disk reads, not
+    the ~17-minute cold XLA compile, on its first slot.  Off-TPU this is
+    a no-op (the warmup API reports it)."""
+    from .common.compile_cache import DEFAULT_BUCKETS, enable, warmup
+
+    if args.compile_cache == "off":
+        # A warmup that persists nothing is minutes of compile thrown
+        # away the moment the process exits — refuse instead.
+        print(json.dumps({"error": "warmup requires a persistent cache; "
+                                   "drop --compile-cache off"}))
+        return 2
+    cache = enable(args.compile_cache or None)
+    buckets = []
+    for part in (args.shapes.split(",") if args.shapes else []):
+        sets, _, keys = part.partition("x")
+        buckets.append((int(sets), int(keys or 1)))
+    out = warmup(buckets or DEFAULT_BUCKETS)
+    out["cache_dir"] = cache
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_db(args) -> int:
     """`database_manager`: inspect a store."""
     from .store import DBColumn, SqliteStore
@@ -354,6 +393,16 @@ def main(argv=None) -> int:
     db = sub.add_parser("db", help="database inspection")
     db.add_argument("path")
     db.set_defaults(fn=cmd_db)
+
+    wu = sub.add_parser("warmup",
+                        help="pre-compile the device hot paths into the "
+                             "persistent compilation cache")
+    wu.add_argument("--compile-cache", default="", metavar="DIR",
+                    help="cache directory (default: <repo>/.jax_cache)")
+    wu.add_argument("--shapes", default="",
+                    help="comma-separated (sets)x(keys) buckets, e.g. "
+                         "'256x16,256x1' (default: the slot-path buckets)")
+    wu.set_defaults(fn=cmd_warmup)
 
     bnode = sub.add_parser("boot-node",
                            help="standalone discovery registry "

@@ -1,0 +1,142 @@
+"""Persistent XLA compilation cache + explicit hot-path warmup.
+
+The first BLS batch in a fresh process pays the full XLA/Mosaic compile
+of the fused pairing pipeline — ~17 minutes through the axon tunnel
+(``batch_cold_ms`` ≈ 1,049,000 in BENCH_LATEST.json) — because nothing
+wired up JAX's persistent compilation cache for the node entry points
+(only bench.py and the test conftest did).  Two pieces fix that:
+
+- :func:`enable` points JAX at a persistent on-disk cache (configurable
+  directory; ``--compile-cache`` in the CLI, ``LH_TPU_JAX_CACHE`` in the
+  environment).  Safe to call from any entry point, idempotent, and a
+  graceful no-op on JAX builds without the feature.
+- :func:`warmup` pre-compiles the bucketed ``(sets, keys)`` shapes of
+  the fused BLS pipeline via ``jit.lower(...).compile()`` — abstract
+  shapes only, no device data — so a restarted node (or one warming in
+  the background at boot) never pays the cold compile in the slot path:
+  with the cache enabled the compiles land on disk, and the first real
+  verify of each bucket is a cache hit.  On CPU this is a graceful
+  no-op: the Pallas programs only lower on TPU, and the scanned-XLA
+  twins take minutes per shape on one core — warming them would cost
+  more than it saves.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+_state = {"dir": None}
+
+
+def default_dir() -> str:
+    """``LH_TPU_JAX_CACHE`` or ``<repo>/.jax_cache`` (the directory
+    bench.py and the tests already share)."""
+    return os.environ.get(
+        "LH_TPU_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+
+
+def enable(cache_dir: Optional[str] = None,
+           min_compile_time_secs: float = 2.0) -> Optional[str]:
+    """Enable JAX's persistent compilation cache at ``cache_dir``.
+
+    Returns the cache directory actually configured, or None when the
+    running JAX has no persistent-cache support (ancient builds — run
+    uncached rather than fail)."""
+    import jax
+
+    cache = os.path.abspath(cache_dir or default_dir())
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+    except Exception:
+        return None
+    try:
+        # The cache object is lazily initialised ONCE per process; if a
+        # compile already ran against another directory, the config
+        # update alone is ignored — reset so the new dir takes effect.
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass  # private API drift: first-configured dir keeps winning
+    _state["dir"] = cache
+    return cache
+
+
+def is_enabled() -> bool:
+    return _state["dir"] is not None
+
+
+def cache_dir() -> Optional[str]:
+    return _state["dir"]
+
+
+# The shape buckets a mainnet node hits in the slot path: the pipeline
+# sub-batch of the 1024-set aggregate-attestation batch (16-key
+# committees), the 256-set sync-committee shape (dedup collapses it to
+# K=1), and the small head-of-slot batches.  (sets, keys) pairs; keys
+# bucket to next-pow2(signer count) and sets to the C chunk count
+# exactly like the dispatcher (which sub-batches at 256 sets, so larger
+# batches reuse the 256-set executable).
+DEFAULT_BUCKETS: Tuple[Tuple[int, int], ...] = (
+    (256, 16), (256, 1), (8, 16), (8, 1),
+)
+
+
+def warmup(buckets: Sequence[Tuple[int, int]] = DEFAULT_BUCKETS,
+           table_cols: int = 1 << 15) -> Dict[str, object]:
+    """Pre-compile the fused BLS pipeline for each ``(sets, keys)``
+    bucket, plus the shared finalize/verdict programs.
+
+    Uses ``jit.lower(abstract shapes).compile()`` — no device inputs are
+    materialised and nothing executes; with :func:`enable` active every
+    compile is persisted, so the next process (or the next call in this
+    one) hits the disk cache instead of XLA.  Returns a summary dict;
+    ``{"skipped": "cpu"}`` off-TPU (see module docstring).
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "cpu", "compiled": []}
+
+    import numpy as np
+
+    from ..crypto import htc_kernel as HK
+    from ..crypto import pairing_kernel as PK
+    from ..crypto import tpu_backend as TB
+    from ..ops.merkle import _next_pow2
+
+    S = PK.PREP_S
+    aval = jax.ShapeDtypeStruct
+    compiled = []
+    for sets, keys in buckets:
+        K = _next_pow2(max(1, int(keys)))
+        C = _next_pow2(max(1, -(-int(sets) // S)))
+        args = (
+            aval((64, table_cols), np.uint32),           # pubkey table
+            aval((C * K * S,), np.int32),                # idx
+            aval((1, C * K * S), np.int32),              # kmask
+            aval((1, C * S), np.uint32),                 # lo
+            aval((1, C * S), np.uint32),                 # hi
+            aval((2 * HK.BLOCK_ROWS, C * 2 * S), np.uint32),  # u planes
+            aval((128, C * S), np.uint32),               # sig cols
+            aval((1, C * S), np.int32),                  # sigmask
+            aval((1, C * S), np.int32),                  # setlive
+        )
+        TB.fused_pipeline_jit().lower(*args, K=K).compile()
+        compiled.append({"sets": int(sets), "keys": int(keys),
+                         "C": C, "K": K})
+    # The shared tail: the finalize fold at the 1- and 4-dispatch group
+    # widths + the scalar verdict combine (the donated twin — the
+    # dispatcher's hot-path entry, so the persisted executable matches
+    # its cache key).
+    for m in (128, 512):
+        PK.finalize_kernel_call_donated.lower(
+            aval((384, m), np.uint32)).compile()
+    for g in (1, 4):
+        TB._combine_verdict.lower(
+            aval((1, 1), np.int32), aval((g,), np.bool_)).compile()
+    return {"cache_dir": cache_dir(), "compiled": compiled}

@@ -143,3 +143,10 @@ histogram = REGISTRY.histogram
 
 def start_timer(name: str, help_: str = "") -> HistogramTimer:
     return REGISTRY.histogram(name, help_).start_timer()
+
+
+def observe(name: str, value: float, help_: str = "") -> None:
+    """One-shot histogram observation — the stage-boundary hook the
+    device pipeline uses (host-prep / transfer / compute / pull), where
+    the section being timed spans threads and a timer guard can't."""
+    REGISTRY.histogram(name, help_).observe(value)

@@ -648,7 +648,12 @@ LANE_BLOCK = 128  # Mosaic lane-concat pieces must be 128-aligned
 # The Miller/prepare/hash kernels' wide-concat mont_mul temporaries brush
 # against Mosaic's default 16 MB scoped-VMEM budget (v5e VMEM is far
 # larger); raise the per-kernel limit rather than contorting the code.
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+# jax ≥ 0.5 renamed TPUCompilerParams → CompilerParams; accept both so
+# the module imports (for warmup shape-lowering and donation tests)
+# under either.
+_CompilerParamsCls = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+_COMPILER_PARAMS = _CompilerParamsCls(vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _line_fq12(A, B, C, m):
@@ -1033,8 +1038,7 @@ def finalize_xla_tail(f_planes):
     return ok.astype(jnp.int32).reshape(1, 1)
 
 
-@jax.jit
-def finalize_kernel_call(f_planes):
+def _finalize_call_body(f_planes):
     """Fold an entire batch's (384, M) lane products (M a power of two,
     ≥ 128) into one Fq12, run the shared final exponentiation on-device,
     and return a (1, 1) int32 ``is_one`` flag — the only bytes the host
@@ -1066,6 +1070,15 @@ def finalize_kernel_call(f_planes):
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
         compiler_params=_COMPILER_PARAMS,
     )(*_const_args(), easy)
+
+
+finalize_kernel_call = jax.jit(_finalize_call_body)
+# Donated twin for the dispatcher's hot path: the (384, M) product
+# concat is batch-local and never re-read, so its buffer (up to MBs at
+# wide M) is recycled in place.  Callers that reuse their input
+# (profiling loops, tests) keep the undonated entry above.
+finalize_kernel_call_donated = jax.jit(_finalize_call_body,
+                                       donate_argnums=(0,))
 
 
 # ---------------------------------------------------------------------------

@@ -28,6 +28,7 @@ the jit cache.
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 from typing import Sequence
 
@@ -237,9 +238,8 @@ def _sigma_g1_cell() -> np.ndarray:
 _SIGMA_G1_CELL = _sigma_g1_cell()
 
 
-@partial(jax.jit, static_argnames=("K",))
-def _fused_pipeline(table, idx, kmask, lo, hi, u_planes, sig_cols,
-                    sigmask, setlive, *, K: int):
+def _fused_pipeline_body(table, idx, kmask, lo, hi, u_planes, sig_cols,
+                         sigmask, setlive, *, K: int):
     """Batch verify up to the 128-class lane products, as one device
     program per (C, K, capacity) shape bucket: pubkey gather →
     hash-to-curve of every message → prepare (G1 aggregation + RLC
@@ -284,6 +284,28 @@ def _fused_pipeline(table, idx, kmask, lo, hi, u_planes, sig_cols,
     return prod, bad
 
 
+_fused_pipeline = partial(
+    jax.jit, static_argnames=("K",))(_fused_pipeline_body)
+# The per-batch marshalled arrays (indices, masks, scalar words, u
+# planes, signature columns) are DONATED: they are built fresh for every
+# dispatch and never re-read on the host, so XLA may overwrite them
+# in place instead of device-side copying ~tens of MB per batch.  The
+# pubkey table (arg 0) is the one long-lived input and stays undonated.
+_fused_pipeline_donated = partial(
+    jax.jit, static_argnames=("K",),
+    donate_argnums=tuple(range(1, 9)))(_fused_pipeline_body)
+
+
+def fused_pipeline_jit(donate: bool | None = None):
+    """The jitted fused-pipeline entry the dispatcher uses on this
+    backend — donated on TPU (default), plain elsewhere (CPU donation is
+    a no-op that only warns).  The warmup path lowers THIS so its
+    persisted executables match the slot path's cache keys."""
+    if donate is None:
+        donate = _use_pallas()
+    return _fused_pipeline_donated if donate else _fused_pipeline
+
+
 @jax.jit
 def _combine_verdict(ok, bads):
     return (ok[0, 0] != 0) & ~jnp.any(bads)
@@ -301,10 +323,15 @@ _ONE_BLOCK = _fq12_one_block()
 
 
 def _marshal_group(entries, rand_fn):
-    """One K-bucket's host marshalling: pubkey-table indices, RLC scalar
+    """One sub-batch's host marshalling: pubkey-table indices, RLC scalar
     words, u-values, signature columns, masks.  Column placement is
     vectorized — the only per-entry Python work left is the pubkey-table
-    dict lookups, the memoised u-column lookups, and ``rand_fn``."""
+    dict lookups, the memoised u-column lookups, and ``rand_fn``.
+
+    Returns HOST numpy arrays (+ the static K bucket): the H2D transfer
+    is a separate pipeline stage (async ``device_put`` by the staged
+    executor) so marshalling of the next sub-batch overlaps this one's
+    transfer and compute."""
     from . import pairing_kernel as PK
     from . import htc_kernel as HK
 
@@ -355,9 +382,49 @@ def _marshal_group(entries, rand_fn):
         sigmask[0, set_col[have_sig]] = 1
     setlive = np.zeros((1, C * S), np.int32)
     setlive[0, set_col] = 1
-    return (jnp.asarray(idx), jnp.asarray(kmask), jnp.asarray(lo),
-            jnp.asarray(hi), jnp.asarray(u_planes), jnp.asarray(sig_cols),
-            jnp.asarray(sigmask), jnp.asarray(setlive), K)
+    return (idx, kmask, lo, hi, u_planes, sig_cols, sigmask, setlive, K)
+
+
+# Stats of the most recent pipelined dispatch, surfaced by bench.py
+# (``stage_overlap_efficiency`` et al).
+LAST_PIPELINE_STATS: dict = {}
+
+
+def _pipeline_sets() -> int:
+    """Sub-batch size (sets per device dispatch) for the staged
+    pipeline.  0 disables sub-batching — one monolithic marshal +
+    dispatch per K-group, the pre-pipeline behaviour."""
+    try:
+        return int(os.environ.get("LIGHTHOUSE_TPU_PIPELINE_SETS", "256"))
+    except ValueError:
+        return 256
+
+
+def _split_batches(entries) -> list:
+    """Work list for the staged executor: entries group by K =
+    next-pow2(signer count) (one 512-key sync-committee set must not pad
+    a thousand single-key sets to K=512), and each group splits into
+    sub-batches of ≤ ``_pipeline_sets()`` sets so host marshalling of
+    sub-batch i+1 overlaps device compute of sub-batch i.
+
+    Sub-batching is only sound when EVERY entry carries its own
+    signature (each sub-batch then verifies an independent pairing
+    product): ``aggregate_verify`` attaches one signature to the whole
+    entry list — splitting it would check ∏ e(pk, H) == 1 without the
+    σ lane — so such batches stay monolithic per group."""
+    groups: dict = {}
+    for e in entries:
+        groups.setdefault(_next_pow2(max(1, len(e[1]))), []).append(e)
+    sub = _pipeline_sets()
+    splittable = sub > 0 and all(e[0] is not None for e in entries)
+    work = []
+    for k in sorted(groups):
+        g = groups[k]
+        if splittable:
+            work.extend(g[j:j + sub] for j in range(0, len(g), sub))
+        else:
+            work.append(g)
+    return work
 
 
 def _dispatch_pallas(entries, rand_fn) -> bool:
@@ -367,33 +434,53 @@ def _dispatch_pallas(entries, rand_fn) -> bool:
 
     (the signature side of the RLC collapses to one pairing lane — the
     same aggregation blst's ``verify_multiple_aggregate_signatures``
-    performs).  Sets group by K = next-pow2(signer count) so one 512-key
-    sync-committee set doesn't pad a thousand single-key sets to K=512;
-    each group runs its own pipeline dispatch, every group's (384, 128)
-    residue products concat into ONE shared finalize (fold + final
-    exponentiation — its ~13-minute XLA compile happens once across all
-    buckets, not per (C, K)), and the host pulls back a single bool.
+    performs).  Work splits per :func:`_split_batches` and runs through
+    the staged executor: marshalling of sub-batch i+1 overlaps the async
+    ``device_put`` + compute of sub-batch i (no ``block_until_ready``
+    between stages), and the marshalled arrays are donated to the jit so
+    the device reuses their buffers in place.  Every sub-batch's
+    (384, 128) residue product concats into ONE shared finalize (fold +
+    final exponentiation — its ~13-minute XLA compile happens once
+    across all buckets, not per (C, K)), and the host pulls back a
+    single bool: still exactly one host sync per verify call.
     Message hashing is host SHA-256 (expand_message_xmd) + the device
     SSWU kernel — no host curve math at all."""
     from . import pairing_kernel as PK
+    from ..parallel.pipeline import StagedExecutor
 
     _PK_TABLE.maybe_reset()
-    groups: dict = {}
-    for e in entries:
-        groups.setdefault(_next_pow2(max(1, len(e[1]))), []).append(e)
-    args = [_marshal_group(groups[k], rand_fn) for k in sorted(groups)]
-    table = _PK_TABLE.device()  # after marshalling registered new keys
-    prods, bads = [], []
-    for (idx, kmask, lo, hi, u, sig, sigmask, setlive, K) in args:
-        prod, bad = _fused_pipeline(table, idx, kmask, lo, hi, u, sig,
-                                    sigmask, setlive, K=K)
-        prods.append(prod)
-        bads.append(bad)
+    work = _split_batches(entries)
+    fused = fused_pipeline_jit()
+    ex = StagedExecutor("bls_pipeline")
+
+    def dispatch(staged):
+        (idx, kmask, lo, hi, u, sig, sigmask, setlive, K) = staged
+        # Table snapshot AFTER this sub-batch's marshalling registered
+        # its new keys; later sub-batches' appends build NEW functional
+        # arrays and cannot disturb an in-flight dispatch.
+        table = _PK_TABLE.device()
+        return fused(table, idx, kmask, lo, hi, u, sig, sigmask,
+                     setlive, K=K)
+
+    results = ex.map(work, lambda batch: _marshal_group(batch, rand_fn),
+                     dispatch)
+    prods = [r[0] for r in results]
+    bads = [r[1] for r in results]
     g = _next_pow2(len(prods))
     prods += [jnp.asarray(_ONE_BLOCK)] * (g - len(prods))
     prod = prods[0] if g == 1 else jnp.concatenate(prods, axis=1)
-    ok = PK.finalize_kernel_call(prod)
-    return bool(_combine_verdict(ok, jnp.stack(bads)))
+    # `prod` is batch-local (fused output or fresh concat) — donated.
+    ok = (PK.finalize_kernel_call_donated(prod) if _use_pallas()
+          else PK.finalize_kernel_call(prod))
+    verdict = bool(_combine_verdict(ok, jnp.stack(bads)))
+    eff = ex.overlap_efficiency()
+    LAST_PIPELINE_STATS.update(
+        dispatches=len(work),
+        staging_fallbacks=ex.stats["fallbacks"],
+        host_prep_ms=round(ex.stats["host_prep_s"] * 1e3, 1),
+        overlap_prep_ms=round(ex.stats["overlap_prep_s"] * 1e3, 1),
+        overlap_efficiency=None if eff is None else round(eff, 3))
+    return verdict
 
 
 def _dedup_shared_keygroups(entries):
@@ -432,14 +519,9 @@ def _dedup_shared_keygroups(entries):
     return out, True
 
 
-def _dispatch(entries, rand_fn) -> bool:
-    """entries: list of (agg_sig_point | None meaning infinity is already
-    rejected, [pubkey points], message bytes).  rand_fn() → 64-bit scalar."""
-    entries, valid = _dedup_shared_keygroups(entries)
-    if not valid:
-        return False
-    if _use_pallas():
-        return _dispatch_pallas(entries, rand_fn)
+def _marshal_xla(entries, rand_fn):
+    """Host marshalling for the pure-XLA kernel: limb arrays for one
+    (sub-)batch, shapes bucketed to powers of two."""
     S = _next_pow2(len(entries))
     K = _next_pow2(max(len(e[1]) for e in entries))
     pk = np.broadcast_to(_G1_IDENT, (S, K, 3, LF.LIMBS)).copy()
@@ -458,10 +540,34 @@ def _dispatch(entries, rand_fn) -> bool:
         c = rand_fn()
         scal[i] = (c & 0xFFFFFFFF, c >> 32)
         smask[i] = True
-    ok = _verify_sets_kernel(jnp.asarray(pk), jnp.asarray(kmask),
-                             jnp.asarray(sig), jnp.asarray(h),
-                             jnp.asarray(scal), jnp.asarray(smask))
-    return bool(ok)
+    return (pk, kmask, sig, h, scal, smask)
+
+
+def _dispatch(entries, rand_fn) -> bool:
+    """entries: list of (agg_sig_point | None meaning infinity is already
+    rejected, [pubkey points], message bytes).  rand_fn() → 64-bit scalar.
+
+    Off-TPU, batches larger than the pipeline sub-batch run through the
+    SAME staged executor as the Pallas path (marshal i+1 overlaps the
+    kernel on i; each sub-batch is an independent product so the AND of
+    the verdicts equals the monolithic verdict) — guarded like
+    :func:`_split_batches` to entries that each carry a signature."""
+    entries, valid = _dedup_shared_keygroups(entries)
+    if not valid:
+        return False
+    if _use_pallas():
+        return _dispatch_pallas(entries, rand_fn)
+    sub = _pipeline_sets()
+    if sub > 0 and len(entries) > sub \
+            and all(e[0] is not None for e in entries):
+        from ..parallel.pipeline import StagedExecutor
+        ex = StagedExecutor("bls_pipeline")
+        outs = ex.map(
+            [entries[j:j + sub] for j in range(0, len(entries), sub)],
+            lambda batch: _marshal_xla(batch, rand_fn),
+            lambda staged: _verify_sets_kernel(*staged))
+        return all(bool(o) for o in outs)
+    return bool(_verify_sets_kernel(*_marshal_xla(entries, rand_fn)))
 
 
 def _host_fastpath_max() -> int:

@@ -234,10 +234,12 @@ class LightClientServer:
 
     def update(self, sync_aggregate,
                signature_slot: int) -> LightClientUpdate:
-        """Period-advancing `LightClientUpdate`
-        (`light_client_update.rs` production): carries the NEXT sync
-        committee with its proof so a client can cross sync-committee
-        periods."""
+        """Period-advancing `LightClientUpdate` built from the LIVE HEAD
+        state.  Only sound when ``sync_aggregate`` actually signed the
+        current head header (e.g. produced in the same slot); for
+        serving, use the update :meth:`updates_for_block` cached at
+        import time instead — pairing a cached aggregate with a later
+        head header yields a signature no spec client accepts."""
         state = self.chain.head.state
         next_branch, _ = state_field_proof(state, "next_sync_committee")
         fin_branch, _ = state_field_proof(state, "finalized_checkpoint")
@@ -255,20 +257,27 @@ class LightClientServer:
     def updates_for_block(self, signed_block):
         """Artifacts triggered by an imported block carrying a live sync
         aggregate (`beacon_chain/src/light_client_server_cache.rs` role):
-        the aggregate attests to the PARENT header.  Returns
-        (optimistic_update | None, finality_update | None)."""
+        the aggregate attests to the PARENT header, so every artifact —
+        including the full period-advancing `LightClientUpdate` — is
+        built from the parent header/state the committee actually
+        signed.  (Rebuilding the period update from the live head at
+        serve time, as the `/updates` route once did, paired the cached
+        aggregate with a header it never signed — cryptographically
+        inconsistent whenever the head had advanced, i.e. almost
+        always.)  Returns (optimistic_update | None,
+        finality_update | None, period_update | None)."""
         import numpy as np
 
         agg = getattr(signed_block.message.body, "sync_aggregate", None)
         if agg is None:
-            return None, None
+            return None, None, None
         bits = np.asarray(agg.sync_committee_bits, dtype=bool)
         if not bits.any():
-            return None, None
+            return None, None, None
         parent = self.chain.store.get_block(
             bytes(signed_block.message.parent_root))
         if parent is None:
-            return None, None
+            return None, None, None
         parent_state = self.chain.state_at_block_root(
             bytes(signed_block.message.parent_root))
         hdr = parent_state.latest_block_header.copy()
@@ -280,16 +289,27 @@ class LightClientServer:
                                           "finalized_checkpoint")
         fin_root = bytes(parent_state.finalized_checkpoint.root)
         fin_block = self.chain.store.get_block(fin_root)
+        fin_header = (self._block_to_header(fin_block.message)
+                      if fin_block is not None else None)
         fin = None
-        if fin_block is not None:
+        if fin_header is not None:
             fin = LightClientFinalityUpdate(
                 attested_header=hdr,
-                finalized_header=self._block_to_header(fin_block.message),
+                finalized_header=fin_header,
                 finality_branch=fin_branch,
                 sync_aggregate=agg, signature_slot=slot,
                 finalized_checkpoint_epoch=int(
                     parent_state.finalized_checkpoint.epoch))
-        return opt, fin
+        next_branch, _ = state_field_proof(parent_state,
+                                           "next_sync_committee")
+        period = LightClientUpdate(
+            attested_header=hdr,
+            next_sync_committee=parent_state.next_sync_committee,
+            next_sync_committee_branch=next_branch,
+            finalized_header=fin_header,
+            finality_branch=fin_branch,
+            sync_aggregate=agg, signature_slot=slot)
+        return opt, fin, period
 
 
 class LightClientStore:

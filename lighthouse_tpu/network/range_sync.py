@@ -108,7 +108,13 @@ class SyncingChain:
 
     def tick(self, node, peer_manager) -> bool:
         """Advance the machine one step; returns True if progress was
-        made (a batch downloaded or processed)."""
+        made — a batch downloaded or processed, OR a download attempt
+        consumed.  A failed download returns the batch to PENDING and
+        still counts as progress: the next tick retries it on the next
+        eligible peer, so one dead top-scored peer cannot abort a whole
+        ``sync_to`` round (it previously did — the driver stopped at the
+        first no-progress tick and peer rotation waited for a later
+        ``_range_sync`` invocation)."""
         progressed = False
         # 1. download the next pending batch
         batch = self._next_downloadable()
@@ -127,7 +133,10 @@ class SyncingChain:
                 peer_manager.report(peer, PeerAction.TIMEOUT)
                 batch.state = (BatchState.FAILED if batch.failed_enough()
                                else BatchState.PENDING)
-                return progressed
+                # An attempt was consumed: loop progress (retry rotates
+                # to the next peer immediately, attempts stay bounded by
+                # MAX_BATCH_ATTEMPTS so this cannot spin forever).
+                return True
             batch.blocks = [
                 b for b in blocks
                 if batch.start_slot <= int(b.message.slot)

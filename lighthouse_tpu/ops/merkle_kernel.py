@@ -31,6 +31,8 @@ and the cross-chunk tail pairs naturally via :func:`..ops.merkle.merkleize`.
 
 from __future__ import annotations
 
+import os
+import time
 from functools import lru_cache, partial
 
 import numpy as np
@@ -226,21 +228,92 @@ def _levels_body(leaves: jnp.ndarray, *, use_kernel: bool):
 
 _levels_device_jit = None
 
+# H2D streaming granularity for big leaf pushes: 2^18 rows = 8 MiB per
+# chunk at (rows, 8) u32.  Overridable (0 disables chunking) via
+# LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS.
+PUSH_CHUNK_ROWS = 1 << 18
 
-def merkle_levels_device(leaves: np.ndarray):
-    """Push ``(w, 8)`` leaves once, compute EVERY tree level in one
-    dispatch, and return ``(root_words, device_levels)`` — the root pulled
-    immediately (32 bytes), the levels left device-resident for the caller
-    to pull lazily (the axon tunnel pulls ~11 MB/s; eager per-level pulls
-    are what made the r3 cold state root take minutes)."""
+# Accumulated stats of chunked device builds since the last
+# :func:`reset_push_stats` (a cold state root runs one build per big
+# field, so totals are what bench.py surfaces as ``leaf_push_*``):
+# wait_ms is the transfer time left on the critical path, overlap_ms
+# the transfer time hidden behind compute.
+LAST_PUSH_STATS: dict = {}
+
+
+def reset_push_stats() -> None:
+    LAST_PUSH_STATS.clear()
+
+
+def _push_chunk_rows() -> int:
+    """The env knob, rounded DOWN to a power of two so it always
+    divides the (power-of-two) leaf widths — a non-divisor value must
+    tune the pipeline, not silently disable it.  ≤ 0 disables."""
+    try:
+        rows = int(os.environ.get("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS",
+                                  str(PUSH_CHUNK_ROWS)))
+    except ValueError:
+        return PUSH_CHUNK_ROWS
+    return 1 << (rows.bit_length() - 1) if rows > 0 else 0
+
+
+def _get_levels_jit():
     global _levels_device_jit
     if _levels_device_jit is None:
         _levels_device_jit = jax.jit(_levels_body,
                                      static_argnames=("use_kernel",))
-    dev = jax.device_put(np.ascontiguousarray(leaves).astype(
-        np.uint32, copy=False))
-    levels = _levels_device_jit(dev, use_kernel=_use_pallas())
-    return np.asarray(levels[-1])[0], levels
+    return _levels_device_jit
+
+
+def merkle_levels_device(leaves: np.ndarray, chunk_rows: int | None = None):
+    """Compute every tree level of ``(w, 8)`` leaves on-device and return
+    ``(root_words, device_levels)`` — the root pulled immediately
+    (32 bytes), the levels left device-resident for the caller to pull
+    lazily (the axon tunnel pulls ~11 MB/s; eager per-level pulls are
+    what made the r3 cold state root take minutes).
+
+    Wide builds stream the leaves in ``chunk_rows``-row column chunks
+    through a background :class:`~lighthouse_tpu.parallel.pipeline.
+    ChunkStager`: chunk i+1 transfers while chunk i's sub-tree levels
+    already reduce on the device (the level-pull machinery in reverse),
+    so the monolithic blocking push disappears from the critical path.
+    The chunk sub-tree levels concat per level into the SAME full-tree
+    levels the monolithic path produces, then the chunk roots reduce to
+    the top — bit-identical output, tested off-device."""
+    leaves = np.ascontiguousarray(leaves).astype(np.uint32, copy=False)
+    w = leaves.shape[0]
+    chunk = _push_chunk_rows() if chunk_rows is None else chunk_rows
+    jit = _get_levels_jit()
+    use_kernel = _use_pallas()
+    if chunk <= 0 or w <= chunk or w % chunk:
+        dev = jax.device_put(leaves)
+        levels = jit(dev, use_kernel=use_kernel)
+        return np.asarray(levels[-1])[0], levels
+
+    from ..parallel.pipeline import ChunkStager
+
+    t0 = time.perf_counter()
+    n_chunks = w // chunk
+    stager = ChunkStager([leaves[i * chunk:(i + 1) * chunk]
+                          for i in range(n_chunks)])
+    parts = [jit(dev, use_kernel=use_kernel) for dev in stager]
+    # Level l of the full tree is the in-order concat of the chunks'
+    # level l (a contiguous chunk is exactly a sub-tree); above the
+    # chunk roots the tail reduces as its own (tiny) levels program.
+    levels = [jnp.concatenate([p[l] for p in parts], axis=0)
+              for l in range(len(parts[0]))]
+    tail = jit(levels[-1], use_kernel=use_kernel)
+    levels.extend(tail[1:])
+    root = np.asarray(levels[-1])[0]
+    for key, add in (
+            ("builds", 1), ("chunks", n_chunks),
+            ("staging_fallbacks", stager.fallbacks),
+            ("wait_ms", round(stager.wait_s * 1e3, 1)),
+            ("overlap_ms", round(
+                max(stager.transfer_s - stager.wait_s, 0.0) * 1e3, 1)),
+            ("wall_ms", round((time.perf_counter() - t0) * 1e3, 1))):
+        LAST_PUSH_STATS[key] = round(LAST_PUSH_STATS.get(key, 0) + add, 1)
+    return root, tuple(levels)
 
 
 @lru_cache(maxsize=8)

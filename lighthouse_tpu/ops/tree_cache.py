@@ -89,14 +89,19 @@ def start_level_pull(dev_levels) -> tuple:
     pull is the cheaper failure mode.
     """
     import threading
+    import time
+
+    from ..common.metrics import observe
 
     box: list = []
 
     def pull():
+        t0 = time.perf_counter()
         try:
             box.append([np.array(lv) for lv in dev_levels])
         except Exception as e:  # pragma: no cover - tunnel hiccup
             box.append(e)
+        observe("merkle_level_pull_seconds", time.perf_counter() - t0)
 
     t = threading.Thread(target=pull, daemon=False)
     t.start()
@@ -126,9 +131,11 @@ class IncrementalMerkleCache:
 
     def _rebuild(self, leaves: np.ndarray) -> np.ndarray:
         """Recompute every stored level from ``leaves`` ((w, 8), w pow2);
-        returns the subtree root words.  Big builds run on the device in one
-        dispatch, with the interior levels pulled by a background thread
-        (the cache stays "pending" until they land)."""
+        returns the subtree root words.  Big builds run on the device —
+        the leaves stream up in column chunks overlapped with the
+        earlier chunks' sub-tree reduction (``merkle_levels_device``'s
+        ChunkStager path) — with the interior levels pulled by a
+        background thread (the cache stays "pending" until they land)."""
         w = leaves.shape[0]
         if w >= DEVICE_BUILD_THRESHOLD and _tpu_attached():
             from .merkle_kernel import merkle_levels_device

@@ -8,5 +8,6 @@ equivalent is a single batched kernel sharded over an ICI mesh with
 pairing partial products) riding XLA collectives.
 """
 
+from .pipeline import ChunkStager, StagedExecutor  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .merkle_shard import sharded_merkle_root  # noqa: F401

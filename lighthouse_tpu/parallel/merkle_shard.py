@@ -18,7 +18,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map  # jax ≥ 0.5 top-level export
+except ImportError:  # jax 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map
 
 from ..ops.merkle import merkleize
 from .mesh import BATCH_AXIS, batch_sharding

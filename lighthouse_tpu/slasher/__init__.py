@@ -79,6 +79,11 @@ class Slasher:
                                     _NO_SPAN_MAX, np.uint16)
         # (validator, target) → AttesterRecord for double votes + evidence.
         self.by_target: Dict[Tuple[int, int], AttesterRecord] = {}
+        # validator → [(source, target)] of WIDE votes (t − s beyond the
+        # span-plane encoding).  The device engine keeps these out of the
+        # plane but must still honour them in surround detection — the
+        # evidence dict is the ground truth the plane only accelerates.
+        self._wide: Dict[int, List[Tuple[int, int]]] = {}
         self.kv = kv or MemoryStore()
         self.queue: List[object] = []
 
@@ -109,9 +114,15 @@ class Slasher:
             s = int(data.source.epoch)
             t = int(data.target.epoch)
             if t < s or t > current_epoch or \
-                    current_epoch - t >= self.history or \
-                    t - s > min(self.history, 0xFFFE):
+                    current_epoch - t >= self.history:
                 continue
+            # Wide-source attestations (t − s beyond the span-plane
+            # encoding) are excluded from the PLANE ingest only: the
+            # by-target double-vote pass below must still see them — the
+            # numpy engine detects doubles for such attestations, and
+            # skipping them here let a crafted wide vote evade detection
+            # on engine='device' (ADVICE r5).
+            wide = t - s > min(self.history, 0xFFFE)
             data_root = data.tree_hash_root()
             idx = np.asarray([int(i) for i in indexed.attesting_indices],
                              dtype=np.int64)
@@ -129,7 +140,25 @@ class Slasher:
                 else:
                     live.append(int(v))
                     self.by_target[(int(v), t)] = rec
-            if live:
+            if not live:
+                continue
+            if wide:
+                # Wide votes bypass the plane entirely; surround checks
+                # run on the evidence dict directly (ground truth — the
+                # plane gathers are only its accelerator).  Wide votes
+                # are adversarial rarities, so the O(dict) scan is off
+                # the hot path.
+                for v in live:
+                    self._wide.setdefault(v, []).append((s, t))
+                    prior = self._find_surrounding(v, s, t)
+                    if prior is not None:
+                        out.append(Slashing("surrounds", v,
+                                            prior.indexed, indexed))
+                    prior = self._find_surrounded(v, s, t)
+                    if prior is not None:
+                        out.append(Slashing("surrounded", v, indexed,
+                                            prior.indexed))
+            else:
                 live_atts.append((s, t, np.asarray(live, np.int64),
                                   indexed, data_root))
         self.queue = []
@@ -163,6 +192,17 @@ class Slasher:
                     batch_subd |= np.isin(live, live2)
             surrounds |= batch_sur
             surrounded |= batch_subd
+            # Wide votes never touched the plane; fold their spans in
+            # from the side index (empty in the non-adversarial case).
+            if self._wide:
+                for j in range(live.shape[0]):
+                    spans = self._wide.get(int(live[j]))
+                    if not spans:
+                        continue
+                    surrounds[j] |= any(s2 < s and t2 > t
+                                        for s2, t2 in spans)
+                    surrounded[j] |= any(s2 > s and t2 < t
+                                         for s2, t2 in spans)
             for v in live[surrounds]:
                 prior = self._find_surrounding(int(v), s, t)
                 if prior is not None:
@@ -294,6 +334,10 @@ class Slasher:
         horizon = current_epoch - self.history
         self.by_target = {k: v for k, v in self.by_target.items()
                           if k[1] > horizon}
+        if self._wide:
+            self._wide = {
+                v: kept for v, spans in self._wide.items()
+                if (kept := [st for st in spans if st[1] > horizon])}
 
 
 def bench_span_update(n_validators: int = 1 << 20, n_atts: int = 1024,

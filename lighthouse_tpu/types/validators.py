@@ -385,16 +385,9 @@ def _registry_raw_columns(reg: "ValidatorRegistry", m: int) -> dict:
     return cols
 
 
-def _registry_levels_body(cols: dict, *, n: int, w: int, use_kernel: bool):
-    """Device body: raw columns (m rows) → tuple of registry tree levels.
-
-    ``levels[0]`` = (w, 8) record roots of the first ``n ≤ m`` records,
-    padded with zero CHUNKS (SSZ list semantics) to the power-of-two width
-    ``w``; ``levels[-1]`` = (1, 8) root of the w-subtree.  Rows n..m are
-    marshalling pad (Pallas needs 2^15-multiples) — their garbage mini-tree
-    roots are sliced off before the zero-chunk padding.
-    """
-    import jax.numpy as jnp
+def _h64_device(use_kernel: bool):
+    """The shared ``hash64`` selector of the device bodies: Pallas for
+    lane counts the kernel can take, XLA scan otherwise."""
     from ..ops.merkle_kernel import hash64_pallas
 
     PB = 1 << 15  # hash64_pallas lane-count granularity
@@ -405,6 +398,16 @@ def _registry_levels_body(cols: dict, *, n: int, w: int, use_kernel: bool):
             return hash64_pallas(a, b)
         return hash64(a, b)
 
+    return h64
+
+
+def _record_roots_body(cols: dict, *, use_kernel: bool):
+    """Device body: raw columns (m rows) → (m, 8) record mini-tree roots.
+    Jitted per chunk shape so the chunked cold build reduces each staged
+    column chunk while later chunks are still in transfer."""
+    import jax.numpy as jnp
+
+    h64 = _h64_device(use_kernel)
     pk = cols["pubkey"]                       # (m, 12) words
     m = pk.shape[0]
     pk_lo = pk[:, :8]
@@ -427,8 +430,27 @@ def _registry_levels_body(cols: dict, *, n: int, w: int, use_kernel: bool):
              leaves[:, 1::2].reshape(4 * m, 8)).reshape(m, 4, 8)
     l2 = h64(l1[:, 0::2].reshape(2 * m, 8),
              l1[:, 1::2].reshape(2 * m, 8)).reshape(m, 2, 8)
-    rec = h64(l2[:, 0], l2[:, 1])             # (m, 8) record roots
-    return _levels_from_records(rec, n, w, h64)
+    return h64(l2[:, 0], l2[:, 1])            # (m, 8) record roots
+
+
+def _registry_levels_body(cols: dict, *, n: int, w: int, use_kernel: bool):
+    """Device body: raw columns (m rows) → tuple of registry tree levels.
+
+    ``levels[0]`` = (w, 8) record roots of the first ``n ≤ m`` records,
+    padded with zero CHUNKS (SSZ list semantics) to the power-of-two width
+    ``w``; ``levels[-1]`` = (1, 8) root of the w-subtree.  Rows n..m are
+    marshalling pad (Pallas needs 2^15-multiples) — their garbage mini-tree
+    roots are sliced off before the zero-chunk padding.
+    """
+    rec = _record_roots_body(cols, use_kernel=use_kernel)
+    return _levels_from_records(rec, n, w, _h64_device(use_kernel))
+
+
+def _levels_combine_body(rec, *, n: int, w: int, use_kernel: bool):
+    """Concatenated per-chunk record roots → the registry tree levels
+    (the tail of :func:`_registry_levels_body`, as its own jit for the
+    chunked cold build)."""
+    return _levels_from_records(rec, n, w, _h64_device(use_kernel))
 
 
 def _levels_from_records(rec, n: int, w: int, h64):
@@ -450,24 +472,59 @@ def _levels_from_records(rec, n: int, w: int, h64):
 
 _PALLAS_PAD = 1 << 15
 _levels_jit = None
+_record_roots_jit = None
+_levels_combine_jit = None
+
+# H2D streaming granularity of the chunked cold build: 2^17 records
+# ≈ 15 MiB of raw columns per chunk (a multiple of the Pallas pad).
+REG_PUSH_CHUNK_ROWS = 1 << 17
 
 # Stage timings of the most recent cold build (ms), for bench reporting:
 # the column push through the axon tunnel (~43 MB/s measured) dominates the
-# on-device compute, and the split keeps the cold number interpretable.
+# on-device compute; ``push_ms`` is the transfer time left on the critical
+# path and ``push_overlap_ms`` the transfer time the chunked pipeline hid
+# behind the earlier chunks' on-device reduction.
 LAST_COLD_TIMINGS: dict = {}
 
 
-def registry_cold_device(reg: "ValidatorRegistry"):
-    """One-dispatch cold build on the attached TPU.
+def _reg_chunk_rows() -> int:
+    """The shared env knob (ROWS, i.e. records — the registry's ~120 B
+    rows make a chunk ~2× the byte size of a same-rows leaf chunk),
+    clamped to a usable multiple of the Pallas pad so a small-but-
+    positive value still chunks instead of silently going monolithic.
+    ≤ 0 disables."""
+    import os
+    try:
+        rows = int(os.environ.get("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS",
+                                  str(REG_PUSH_CHUNK_ROWS)))
+    except ValueError:
+        return REG_PUSH_CHUNK_ROWS
+    if rows <= 0:
+        return 0
+    return max((rows // _PALLAS_PAD) * _PALLAS_PAD, _PALLAS_PAD)
+
+
+def registry_cold_device(reg: "ValidatorRegistry",
+                         chunk_rows: int | None = None):
+    """Cold build on the attached TPU with a streamed column push.
 
     Returns ``(root_words, levels)``: ``root_words`` is the (8,) u32 root of
     the occupied power-of-two subtree (host numpy, pulled immediately);
     ``levels`` are the device-resident tree levels for the caller to pull
     lazily into the host incremental cache.
-    """
-    global _levels_jit
+
+    Registries wider than one push chunk stream their raw columns up in
+    row chunks via a background :class:`~lighthouse_tpu.parallel.
+    pipeline.ChunkStager`: chunk i+1 transfers while chunk i's record
+    mini-trees already reduce on-device, and a final combine program
+    builds the registry levels over the concatenated record roots —
+    the monolithic blocking push (5+ s of the cold state root at 2^20)
+    leaves the critical path.  Small registries keep the one-dispatch
+    monolithic body."""
+    global _levels_jit, _record_roots_jit, _levels_combine_jit
     import time
     import jax
+    import jax.numpy as jnp
     from ..ops.merkle import _next_pow2
     from ..ops.merkle_kernel import _use_pallas
 
@@ -475,19 +532,51 @@ def registry_cold_device(reg: "ValidatorRegistry"):
     w = _next_pow2(max(n, 1))
     # Pad rows to the Pallas granularity; slice the pad off on-device.
     m = max(-(-n // _PALLAS_PAD) * _PALLAS_PAD, _PALLAS_PAD)
+    use_kernel = _use_pallas()
+    chunk = _reg_chunk_rows() if chunk_rows is None else chunk_rows
+    if chunk <= 0 or m <= chunk or chunk % _PALLAS_PAD:
+        t0 = time.perf_counter()
+        cols = {k: jax.device_put(v)
+                for k, v in _registry_raw_columns(reg, m).items()}
+        jax.block_until_ready(cols)
+        t1 = time.perf_counter()
+        if _levels_jit is None:
+            _levels_jit = jax.jit(_registry_levels_body,
+                                  static_argnames=("n", "w", "use_kernel"))
+        levels = _levels_jit(cols, n=n, w=w, use_kernel=use_kernel)
+        root_words = np.asarray(levels[-1])[0]
+        t2 = time.perf_counter()
+        LAST_COLD_TIMINGS.update(
+            push_ms=round((t1 - t0) * 1e3, 1),
+            compute_ms=round((t2 - t1) * 1e3, 1),
+            push_overlap_ms=0.0, push_chunks=1)
+        return root_words, levels
+
+    from ..parallel.pipeline import ChunkStager
+
     t0 = time.perf_counter()
-    cols = {k: jax.device_put(v)
-            for k, v in _registry_raw_columns(reg, m).items()}
-    jax.block_until_ready(cols)
-    t1 = time.perf_counter()
-    if _levels_jit is None:
-        _levels_jit = jax.jit(_registry_levels_body,
-                              static_argnames=("n", "w", "use_kernel"))
-    levels = _levels_jit(cols, n=n, w=w, use_kernel=_use_pallas())
+    host = _registry_raw_columns(reg, m)
+    chunks = [{k: v[b:b + chunk] for k, v in host.items()}
+              for b in range(0, m, chunk)]
+    stager = ChunkStager(chunks)
+    if _record_roots_jit is None:
+        _record_roots_jit = jax.jit(_record_roots_body,
+                                    static_argnames=("use_kernel",))
+        _levels_combine_jit = jax.jit(
+            _levels_combine_body, static_argnames=("n", "w", "use_kernel"))
+    recs = [_record_roots_jit(dev, use_kernel=use_kernel)
+            for dev in stager]
+    rec = recs[0] if len(recs) == 1 else jnp.concatenate(recs, axis=0)
+    levels = _levels_combine_jit(rec, n=n, w=w, use_kernel=use_kernel)
     root_words = np.asarray(levels[-1])[0]
-    t2 = time.perf_counter()
-    LAST_COLD_TIMINGS["push_ms"] = round((t1 - t0) * 1e3, 1)
-    LAST_COLD_TIMINGS["compute_ms"] = round((t2 - t1) * 1e3, 1)
+    wall = time.perf_counter() - t0
+    LAST_COLD_TIMINGS.update(
+        push_ms=round(stager.wait_s * 1e3, 1),
+        compute_ms=round(max(wall - stager.wait_s, 0.0) * 1e3, 1),
+        push_overlap_ms=round(
+            max(stager.transfer_s - stager.wait_s, 0.0) * 1e3, 1),
+        push_chunks=len(chunks),
+        push_fallbacks=stager.fallbacks)
     return root_words, levels
 
 

@@ -333,3 +333,65 @@ def test_node_identity_route(api):
         assert attnets & (1 << 3)
     finally:
         net.close()
+
+
+def test_validators_malformed_pagination_is_400(api):
+    """ADVICE r5: `?offset=abc` raised a bare ValueError out of the
+    handler (500/connection drop); it must take the same 400 path as a
+    malformed id filter."""
+    h, chain, srv = api
+    for query in ("offset=abc", "limit=abc", "offset=1&limit=x",
+                  "offset=-5", "limit=-1"):
+        try:
+            _get(srv, f"/eth/v1/beacon/states/head/validators?{query}")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    # id filter unchanged
+    try:
+        _get(srv, "/eth/v1/beacon/states/head/validators?id=zz")
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_lc_updates_route_serves_import_time_update(api):
+    """ADVICE r5: /light_client/updates must serve the update cached at
+    block import — attested_header = the PARENT header the aggregate
+    signed (signature_slot strictly after it), branches from the parent
+    state — instead of pairing the cached aggregate with the live head
+    header (which the committee never signed)."""
+    import urllib.error
+    h, chain, srv = api
+    for _ in range(5 * h.preset.SLOTS_PER_EPOCH):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb)
+    upd = _get(srv, "/eth/v1/beacon/light_client/updates")["data"][0]
+    sig_slot = int(upd["signature_slot"])
+    att_slot = int(upd["attested_header"]["beacon"]["slot"])
+    # the aggregate signs the PARENT of the block that carried it
+    assert sig_slot > att_slot, \
+        "attested header is not older than the signature slot"
+    # the served attested header IS that parent block's header: its
+    # state_root matches the stored parent block at att_slot
+    head_block = chain.store.get_block(chain.head.root)
+    assert sig_slot == int(head_block.message.slot)
+    parent = chain.store.get_block(bytes(head_block.message.parent_root))
+    assert att_slot == int(parent.message.slot)
+    assert upd["attested_header"]["beacon"]["state_root"] == \
+        "0x" + bytes(parent.message.state_root).hex()
+    # the next-sync-committee branch proves against the PARENT state
+    # root (the state the aggregate's header commits to)
+    from lighthouse_tpu.light_client import verify_field_proof
+    from lighthouse_tpu.ssz.json import from_json
+    committee = from_json(h.T.SyncCommittee, upd["next_sync_committee"])
+    branch = [bytes.fromhex(b[2:])
+              for b in upd["next_sync_committee_branch"]]
+    parent_state = chain.state_at_block_root(
+        bytes(head_block.message.parent_root))
+    idx = list(type(parent_state).FIELDS).index("next_sync_committee")
+    assert verify_field_proof(
+        h.T.SyncCommittee.hash_tree_root(committee), branch, idx,
+        bytes(parent.message.state_root))

@@ -121,3 +121,44 @@ def test_light_client_store_follows_chain_via_updates():
                      signature_slot=int(bad.signature_slot))
     store.optimistic_header = bs.header  # rewind so slot check passes
     assert not store.process_optimistic_update(bad2)
+
+
+def test_period_update_cached_at_import_is_consistent(chain_setup):
+    """The period-advancing LightClientUpdate produced at block import
+    pairs the sync aggregate with the PARENT header it signed and proves
+    its branches against the parent state — never the live head
+    (ADVICE r5: the head rebuild served updates no spec client could
+    verify)."""
+    h, chain = chain_setup
+    last = None
+    for _ in range(6):
+        signed = h.build_block()
+        h.apply_block(signed)
+        chain.per_slot_task(int(signed.message.slot))
+        chain.process_block(signed)
+        last = signed
+    upd = chain.lc_period_update
+    assert upd is not None
+    # attested header = the parent header of the LAST aggregate-carrying
+    # block; its sync aggregate is that block's, signature_slot the
+    # block's slot (strictly after the attested header).
+    assert int(upd.signature_slot) == int(last.message.slot)
+    assert int(upd.attested_header.slot) < int(upd.signature_slot)
+    assert bytes(upd.attested_header.state_root) == \
+        bytes(chain.store.get_block(
+            bytes(last.message.parent_root)).message.state_root)
+    assert upd.sync_aggregate is last.message.body.sync_aggregate
+    # both branches verify against the ATTESTED header's state root
+    parent_state = chain.state_at_block_root(
+        bytes(last.message.parent_root))
+    names = list(type(parent_state).FIELDS)
+    att_root = bytes(upd.attested_header.state_root)
+    assert verify_field_proof(
+        type(parent_state).FIELDS["next_sync_committee"].hash_tree_root(
+            upd.next_sync_committee),
+        upd.next_sync_committee_branch,
+        names.index("next_sync_committee"), att_root)
+    cp = parent_state.finalized_checkpoint
+    assert verify_field_proof(
+        cp.tree_hash_root(), upd.finality_branch,
+        names.index("finalized_checkpoint"), att_root)

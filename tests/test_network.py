@@ -301,3 +301,55 @@ def test_rpc_token_bucket_refill():
     assert b2.allow(cost=8.0)
     assert not b2.allow(cost=8.0)
     assert b2.allow(cost=2.0)
+
+
+def test_range_sync_one_dead_peer_does_not_stall_the_round():
+    """ADVICE r5: a failed download used to return the batch to PENDING
+    with progressed=False, so ``sync_to`` aborted its whole round at the
+    first timeout from the (top-scored) dead peer and rotation waited
+    for a later invocation.  An attempt consumed must count as loop
+    progress: the SAME sync_to call retries on the next eligible peer
+    and completes."""
+    from lighthouse_tpu.network.range_sync import RangeSync
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    bus = GossipBus()
+    full = _make_node(h, bus, "full")
+    late = _make_node(h, bus, "late")  # stays at genesis
+    blocks = []
+    for _ in range(2 * h.preset.SLOTS_PER_EPOCH + 2):
+        sb = h.build_block()
+        h.apply_block(sb)
+        blocks.append(sb)
+    for sb in blocks:
+        full.chain.per_slot_task(int(sb.message.slot))
+        full.chain.process_block(sb)
+
+    class _DeadPeer:
+        """Advertises the same head but times out every request."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.timeouts = 0
+
+        def head_slot(self):
+            return self._inner.head_slot()
+
+        def blocks_by_range(self, req):
+            self.timeouts += 1
+            raise TimeoutError("dead peer")
+
+        def blocks_by_root(self, roots):
+            raise TimeoutError("dead peer")
+
+    dead = _DeadPeer(full)
+    late.peers = [dead, full]
+
+    rs = RangeSync(late)
+    target = full.head_slot()
+    # ONE sync_to round must reach the target despite the dead peer
+    # being attempted (and penalized) along the way.
+    assert rs.sync_to(target)
+    assert late.chain.head.slot == target
+    assert dead.timeouts >= 1  # the dead peer really was attempted
+    assert late.peer_manager.score(dead) < 0

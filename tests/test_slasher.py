@@ -212,3 +212,55 @@ def test_device_engine_matches_numpy_engine():
     assert results["numpy"] == results["device"]
     assert ("surrounds", 5) in results["device"]
     assert ("double", 7) in results["device"]
+
+
+def test_device_engine_matches_numpy_engine_wide_source():
+    """ADVICE r5: a wide-source attestation (t − s beyond the span-plane
+    encoding) must still hit the by-target double-vote pass on the
+    device engine — it is excluded from the PLANE ingest only.  Before
+    the fix a crafted wide vote evaded double detection on
+    engine='device' while the numpy engine caught it."""
+    import numpy as np
+
+    from lighthouse_tpu.slasher import Slasher
+    from lighthouse_tpu.types.presets import MINIMAL
+    from lighthouse_tpu.types.factory import spec_types
+
+    T = spec_types(MINIMAL)
+
+    def att(s, t, indices, salt=0):
+        data = T.AttestationData(
+            slot=t * 8, index=0, beacon_block_root=bytes([salt]) * 32,
+            source=T.Checkpoint(epoch=s, root=b"\x00" * 32),
+            target=T.Checkpoint(epoch=t, root=bytes([salt]) * 32))
+        return type("IA", (), {"data": data,
+                               "attesting_indices": indices})()
+
+    H, cur = 32, 40
+    # t − s = 39 > min(history, 0xFFFE) = 32 → wide; target fresh
+    # (cur − t < H) and not in the future, so only the span planes
+    # cannot represent it.
+    wide_a = att(1, 40, [3, 5])
+    normal_c = att(10, 12, [9])
+    # second batch: a double on the wide vote, a normal vote surrounded
+    # by the earlier wide one, and a wide vote surrounding the earlier
+    # normal one — every wide/plane interaction direction.
+    normal_b = att(10, 12, [5])
+    wide_d = att(2, 39, [9])
+    wide_b = att(1, 40, [3], salt=1)   # same target, different data
+    results = {}
+    for engine in ("numpy", "device"):
+        sl = Slasher(64, history_length=H, engine=engine)
+        sl.accept_attestation(wide_a)
+        sl.accept_attestation(normal_c)
+        assert sl.process_queued(cur) == []
+        for a in (normal_b, wide_d, wide_b):
+            sl.accept_attestation(a)
+        found = sl.process_queued(cur)
+        results[engine] = sorted(
+            (x.kind, x.validator_index) for x in found)
+    assert results["numpy"] == results["device"]
+    assert ("double", 3) in results["device"]
+    # the wide vote still surrounds / is surrounded across batches
+    assert ("surrounds", 5) in results["device"]
+    assert ("surrounded", 9) in results["device"]
