@@ -39,11 +39,19 @@ Also measured (BASELINE rows 2-5 + latency tier):
   attestations (BASELINE row 5).
 - ``slasher_update_1m_ms`` — slasher min/max span-plane ingest for a
   batch of attestations over a 2^20-validator registry (VERDICT r4 #9).
+- ``kzg_batch_verify_ms`` — Deneb blob-sidecar batch verification
+  (6 mainnet-width blobs): device barycentric evaluation + 2 Miller
+  lanes per blob + one shared final exponentiation, with per-stage
+  timings (``kzg_eval_ms`` / ``kzg_pairing_ms`` / ...).
 - ``stage_overlap_efficiency`` — fraction of BLS host marshalling the
   staged pipeline hid behind device compute (1.0 = all sub-batch preps
   after the first ran under an in-flight dispatch), with
   ``pipeline_dispatches`` / ``pipeline_host_prep_ms`` /
   ``pipeline_overlap_prep_ms`` carrying the raw decomposition.
+
+A short-timeout ``jax.devices()`` probe runs before the row loop: a dead
+axon tunnel yields an explicit ``backend_unavailable`` error row
+immediately instead of burning the 2700 s per-row watchdog into rc=124.
 
 ``vs_baseline`` compares against a **native single-core blst estimate** of
 0.7 ms/set for ``verify_multiple_aggregate_signatures`` (1 Miller loop +
@@ -362,6 +370,110 @@ def _slasher_bench() -> dict:
                              history=512, per_att=256)
 
 
+def _kzg_bench() -> dict:
+    """Deneb data-availability workload: verify_blob_kzg_proof_batch over
+    a block's worth of mainnet-width blobs through the device path
+    (barycentric Fr kernel + 2-lanes-per-blob Miller batch + shared final
+    exponentiation), stage timings from kzg.device.LAST_KZG_TIMINGS.
+
+    Fixtures come from the INSECURE known-tau setup: commitments/proofs
+    via one G1 scalar-mul each instead of a width-sized MSM — the
+    VERIFIER's work (the thing measured) is identical to a ceremony
+    setup's.
+    """
+    import random
+    from lighthouse_tpu.kzg import device as D, kzg as K
+    from lighthouse_tpu.kzg.fr import BLS_MODULUS
+    from lighthouse_tpu.kzg.trusted_setup import verification_setup
+
+    width = int(os.environ.get("BENCH_KZG_WIDTH", "4096"))
+    n_blobs = int(os.environ.get("BENCH_KZG_BLOBS", "6"))  # MAX_BLOBS
+    t0 = time.perf_counter()
+    # Verifier-only setup: the known-tau commit/prove fast paths and the
+    # verifier never read g1_lagrange, so skip the width-sized table.
+    setup = verification_setup(width)
+    rng = random.Random(0)
+    blobs, cms, pfs = [], [], []
+    for _ in range(n_blobs):
+        blob = K.polynomial_to_blob(
+            [rng.randrange(BLS_MODULUS) for _ in range(width)])
+        cm = K.blob_to_kzg_commitment(blob, setup)
+        blobs.append(blob)
+        cms.append(cm)
+        pfs.append(K.compute_blob_kzg_proof(blob, cm, setup))
+    setup_s = time.perf_counter() - t0
+
+    # Correctness gates (+ kernel warm-up): valid accepted, tampered
+    # rejected, device agrees with the host RLC fold.
+    t0 = time.perf_counter()
+    if not K.verify_blob_kzg_proof_batch(blobs, cms, pfs, setup,
+                                         use_device=True):
+        raise RuntimeError("valid blob batch rejected")
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    # Tamper: blob 0's proof replaced by its commitment — a valid G1
+    # point that is the wrong proof for ANY batch size (incl. n_blobs=1).
+    if K.verify_blob_kzg_proof_batch(blobs, cms,
+                                     [cms[0]] + pfs[1:], setup,
+                                     use_device=True):
+        raise RuntimeError("tampered blob batch accepted")
+    if not K.verify_blob_kzg_proof_batch(blobs, cms, pfs, setup,
+                                         use_device=False):
+        raise RuntimeError("host fallback rejected a valid batch")
+
+    ts = []
+    for _ in range(RUNS):
+        t0 = time.perf_counter()
+        if not K.verify_blob_kzg_proof_batch(blobs, cms, pfs, setup,
+                                             use_device=True):
+            raise RuntimeError("valid batch rejected in timing loop")
+        ts.append((time.perf_counter() - t0) * 1e3)
+    best = min(ts)
+    stages = dict(D.LAST_KZG_TIMINGS)
+    return {
+        "kzg_batch_verify_ms": round(best, 1),
+        "kzg_batch_cold_ms": round(cold_ms, 1),
+        "kzg_blobs": n_blobs,
+        "kzg_field_elements_per_blob": width,
+        "kzg_blobs_per_s": round(n_blobs / (best / 1e3), 1),
+        "kzg_challenge_ms": stages.get("challenge_ms"),
+        "kzg_eval_ms": stages.get("eval_ms"),
+        "kzg_lane_prep_ms": stages.get("lane_prep_ms"),
+        "kzg_pairing_ms": stages.get("pairing_ms"),
+        "kzg_pairing_lanes": stages.get("lanes"),
+        "kzg_setup_s": round(setup_s, 1),
+    }
+
+
+def _probe_backend(timeout_s: float) -> str | None:
+    """Fail-fast device probe (round-5 VERDICT): `jax.devices()` through a
+    dead axon tunnel can block until the per-row watchdog hard-exits the
+    whole run as rc=124; probing on a daemon thread with a short timeout
+    converts that into an explicit `backend_unavailable` row instead.
+    Returns an error string, or None when the backend answered."""
+    import threading
+
+    result: list = []
+
+    def probe() -> None:
+        try:
+            import jax
+            result.append(("ok", [str(d) for d in jax.devices()]))
+        except Exception as e:  # noqa: BLE001
+            result.append(("error", f"{type(e).__name__}: {e}"))
+
+    t = threading.Thread(target=probe, name="backend-probe", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        return f"backend_unavailable: jax.devices() exceeded {timeout_s}s"
+    kind, payload = result[0]
+    if kind == "error":
+        return f"backend_unavailable: {payload}"
+    print(json.dumps({"metric": "backend_probe", "devices": payload}),
+          flush=True)
+    return None
+
+
 # (name, fn, emitted-metric-name).  FAST rows first: the BLS row pays a
 # ~15-20 min per-process TRACE before it can answer (lax.scan pairing
 # graphs on one python core), so under an unknown driver timeout the
@@ -376,6 +488,7 @@ _ROWS = [
     ("slasher", _slasher_bench, "slasher_span_update_1m"),
     ("block", _block_transition_bench, "block_transition_128att"),
     ("stages", _stage_split_bench, "bls_stage_split"),
+    ("kzg", _kzg_bench, "kzg_batch_verify"),
     ("bls", _bls_bench, "bls_batch_verify_%d_sets" % N_SETS),
 ]
 
@@ -394,6 +507,16 @@ def main() -> None:
     # emission).  Cold compiles legitimately run ~35 min, hence the
     # generous default.
     row_timeout = float(os.environ.get("BENCH_ROW_TIMEOUT_S", "2700"))
+
+    # Fail-fast backend probe: every row needs a live device; a wedged
+    # tunnel should cost the probe timeout, not 2700 s of watchdog.
+    probe_err = _probe_backend(
+        float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120")))
+    if probe_err is not None:
+        _emit({"metric": "backend_probe", "error": probe_err})
+        print(json.dumps(_combined({"backend_error": probe_err},
+                                   [name for name, _, _ in _ROWS])))
+        return
 
     merged: dict = {}
     skipped: list = []

@@ -214,6 +214,35 @@ class HttpApiServer:
                         "signature": "0x" + bytes(block.signature).hex()}}})
             else:
                 h._json({"version": "capella", "data": to_json(block)})
+        elif path.startswith("/eth/v1/beacon/blob_sidecars/"):
+            # Deneb blob sidecars for a block (`http_api` blob route,
+            # standard beacon-API `getBlobSidecars`), with the optional
+            # ?indices=0,1 filter.
+            block_id = path.split("/")[-1]
+            try:
+                block, root = self._block(block_id)
+            except ValueError as e:
+                h._json({"code": 400, "message": str(e)}, 400)
+                return
+            if block is None:
+                h._json({"code": 404, "message": "block not found"}, 404)
+                return
+            qs = parse_qs(urlparse(h.path).query)
+            want = None
+            if "indices" in qs:
+                try:
+                    want = {int(x) for part in qs["indices"]
+                            for x in part.split(",")}
+                    if any(i < 0 for i in want):
+                        raise ValueError("negative index")
+                except ValueError:
+                    h._json({"code": 400, "message": "bad indices"}, 400)
+                    return
+            sidecars = chain.store.get_blob_sidecars(root)
+            if want is not None:
+                sidecars = [sc for sc in sidecars if int(sc.index) in want]
+            h._json({"data": [to_json(sc) for sc in sidecars],
+                     "execution_optimistic": False, "finalized": False})
         elif path == "/eth/v1/beacon/pool/attestations":
             atts = []
             for entry in chain.op_pool.attestations.values():

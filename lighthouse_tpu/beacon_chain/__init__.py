@@ -12,8 +12,11 @@ from .attestation_verification import (
     VerifiedAttestation,
     batch_verify_attestations,
 )
+from .data_availability import DataAvailabilityChecker, build_blob_sidecars
 from .errors import (
     AttestationError,
+    BlobSidecarError,
+    BlobsUnavailable,
     BlockError,
     BlockIsAlreadyKnown,
     FutureSlot,
@@ -31,5 +34,6 @@ __all__ = [
     "batch_verify_attestations", "BlockError", "AttestationError",
     "BlockIsAlreadyKnown", "FutureSlot", "ParentUnknown",
     "IncorrectProposer", "ProposalSignatureInvalid", "InvalidSignatures",
-    "StateRootMismatch", "RepeatProposal",
+    "StateRootMismatch", "RepeatProposal", "BlobsUnavailable",
+    "BlobSidecarError", "DataAvailabilityChecker", "build_blob_sidecars",
 ]

@@ -60,7 +60,8 @@ class GossipVerifiedBlock:
         # Proposer-equivocation guard, peek only — recorded after the
         # signature check (`observed_block_producers.rs` two-phase).
         proposer = int(block.proposer_index)
-        if chain.observed_block_producers.has_been_observed(slot, proposer):
+        if chain.observed_block_producers.has_been_observed(slot, proposer,
+                                                            block_root):
             raise RepeatProposal(f"proposer {proposer} already proposed at "
                                  f"slot {slot}")
         # Advance the parent state to the block slot for committee checks
@@ -86,7 +87,7 @@ class GossipVerifiedBlock:
             block_root=block_root)
         if not bls.verify_signature_sets([pset]):
             raise ProposalSignatureInvalid(block_root.hex())
-        chain.observed_block_producers.observe(slot, proposer)
+        chain.observed_block_producers.observe(slot, proposer, block_root)
         return cls(signed_block=signed_block, block_root=block_root,
                    parent_state=state)
 

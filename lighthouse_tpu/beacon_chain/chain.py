@@ -109,6 +109,8 @@ class BeaconChain:
         self.sync_message_pool = SyncMessagePool(preset)
         self.event_bus = EventBus()
         self.validator_monitor = None  # opt-in: set a ValidatorMonitor
+        from .data_availability import DataAvailabilityChecker
+        self.data_availability = DataAvailabilityChecker(preset, T)
         self.genesis_block_root = genesis_block_root
         self.fork_choice = ForkChoice(
             preset, spec, genesis_root=genesis_block_root,
@@ -197,6 +199,8 @@ class BeaconChain:
         chain.sync_message_pool = SyncMessagePool(preset)
         chain.event_bus = EventBus()
         chain.validator_monitor = None
+        from .data_availability import DataAvailabilityChecker
+        chain.data_availability = DataAvailabilityChecker(preset, T)
         chain.genesis_block_root = genesis_root
         chain.genesis_state_root = genesis_state_root
         chain.fork_choice = fc
@@ -257,6 +261,12 @@ class BeaconChain:
         self.observed_block_producers.prune(slot)
         # Sync votes are only read for the previous slot's aggregate.
         self.sync_message_pool.prune(slot - 1)
+        # Pending (never-imported) sidecars die with the gossip window —
+        # both directions: stale ones behind it AND fabricated far-future
+        # headers ahead of it.
+        self.data_availability.prune(
+            slot - ATTESTATION_PROPAGATION_SLOT_RANGE,
+            horizon_slot=slot + ATTESTATION_PROPAGATION_SLOT_RANGE)
         # State-advance timer (`state_advance_timer.rs`): pre-advance the
         # head state to the new slot so the first block/attestation of the
         # slot finds its committees without paying the epoch transition on
@@ -408,14 +418,42 @@ class BeaconChain:
 
     # -- block import pipeline ----------------------------------------------
 
-    def process_block(self, signed_block, *, is_timely: bool = False) -> bytes:
-        """Full pipeline: gossip → bulk signatures → execution → fork
-        choice import → persistence → head update.  Returns the block root
-        (`beacon_chain.rs:2599` + `import_execution_pending_block:2679`)."""
+    def process_block(self, signed_block, *, is_timely: bool = False,
+                      blob_sidecars=None) -> bytes:
+        """Full pipeline: gossip → bulk signatures → execution →
+        availability gate → fork choice import → persistence → head
+        update.  Returns the block root (`beacon_chain.rs:2599` +
+        `import_execution_pending_block:2679`).
+
+        ``blob_sidecars`` optionally carries the block's sidecars inline
+        (the block-publish path, where proposer and blobs arrive
+        together); gossip-delivered sidecars land in
+        ``self.data_availability`` beforehand.  A fully-verified Deneb
+        block whose commitments lack verified blobs raises
+        :class:`~.errors.BlobsUnavailable` and is NOT imported — the
+        network layer retries after fetching the blobs.
+        """
         g = GossipVerifiedBlock.new(self, signed_block)
         self.block_times_cache.observed(g.block_root)
-        sv = SignatureVerifiedBlock.from_gossip_verified(self, g)
-        ex = ExecutedBlock.from_signature_verified(self, sv)
+        if blob_sidecars:
+            self.data_availability.put_sidecars(list(blob_sidecars))
+        ex = self.data_availability.pop_executed_block(g.block_root)
+        if ex is None:
+            sv = SignatureVerifiedBlock.from_gossip_verified(self, g)
+            ex = ExecutedBlock.from_signature_verified(self, sv)
+        # Availability is asserted AFTER full verification (the reference
+        # gates between execution and fork-choice import): only blocks
+        # whose proposer signature and transition are already proven wait
+        # on blobs, so an attacker cannot park junk in the pending map
+        # under a real block's root and stall it.  A stalled block is
+        # parked; its retry (same root — NOT a repeat proposal) resumes
+        # from the executed stage.
+        try:
+            self.data_availability.check_availability(signed_block,
+                                                      g.block_root)
+        except BlockError:
+            self.data_availability.hold_executed_block(g.block_root, ex)
+            raise
         self._import_block(ex, is_timely=is_timely)
         return ex.block_root
 
@@ -425,6 +463,10 @@ class BeaconChain:
         state_root = bytes(ex.signed_block.message.state_root)
         self.store.put_block(block_root, ex.signed_block)
         self.store.put_state(state_root, state.copy(), block_root)
+        # Persist the availability-gate sidecars alongside the block
+        # (served by blob_sidecars_by_range/by_root and the HTTP API).
+        for sc in self.data_availability.take_sidecars(block_root):
+            self.store.put_blob_sidecar(block_root, int(sc.index), sc)
         self.fork_choice.on_block(ex.signed_block, block_root, state,
                                   is_timely=is_timely)
         self._states_by_block[block_root] = state

@@ -50,6 +50,17 @@ class PayloadInvalid(BlockError):
     pass
 
 
+class BlobsUnavailable(BlockError):
+    """Deneb availability gate: the block's KZG commitments have no
+    matching verified blob sidecars yet (retryable — blobs may still
+    arrive over gossip or by-root requests)."""
+
+
+class BlobSidecarError(ValueError):
+    """A blob sidecar failed verification (bad index, inclusion proof,
+    or KZG proof)."""
+
+
 class AttestationError(ValueError):
     pass
 

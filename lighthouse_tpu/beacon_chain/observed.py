@@ -41,26 +41,36 @@ class ObservedAggregators(ObservedAttesters):
 
 
 class ObservedBlockProducers:
-    """Per-slot proposer dedup (`observed_block_producers.rs`)."""
+    """Per-slot proposer dedup (`observed_block_producers.rs`).
+
+    Keyed by (slot, proposer) → block root: seeing the SAME root again is
+    a retry (e.g. a Deneb block re-processed once its blobs arrive), not
+    an equivocation — only a DIFFERENT root from the same proposer at the
+    same slot trips the repeat-proposal rejection (the spec gossip rule
+    keys "first block" by root; identical re-delivery is deduped by the
+    already-known check upstream)."""
 
     def __init__(self, horizon_slots: int = 64):
         self.horizon = horizon_slots
-        self._by_slot: Dict[int, Set[int]] = {}
+        self._by_slot: Dict[int, Dict[int, bytes]] = {}
 
-    def observe(self, slot: int, proposer_index: int) -> bool:
-        seen = self._by_slot.setdefault(slot, set())
-        if proposer_index in seen:
+    def observe(self, slot: int, proposer_index: int,
+                block_root: bytes = b"") -> bool:
+        seen = self._by_slot.setdefault(slot, {})
+        if proposer_index in seen and seen[proposer_index] != block_root:
             return False
-        seen.add(proposer_index)
+        seen[proposer_index] = block_root
         return True
 
-    def has_been_observed(self, slot: int, proposer_index: int) -> bool:
+    def has_been_observed(self, slot: int, proposer_index: int,
+                          block_root: bytes = b"") -> bool:
         """Peek without recording — the gossip pipeline checks early but
         only records AFTER the proposal signature verifies, so unsigned
         junk cannot censor an honest proposer
         (`observed_block_producers.rs` proposer_has_been_observed vs
         observe_proposer two-phase)."""
-        return proposer_index in self._by_slot.get(slot, set())
+        seen = self._by_slot.get(slot, {})
+        return proposer_index in seen and seen[proposer_index] != block_root
 
     def prune(self, current_slot: int) -> None:
         for s in [s for s in self._by_slot if s + self.horizon < current_slot]:

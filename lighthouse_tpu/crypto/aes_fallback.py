@@ -1,0 +1,107 @@
+"""Pure-python AES-128-CTR — dependency-free fallback for EIP-2335
+keystores when the ``cryptography`` package is absent.
+
+Keystore payloads are 32 bytes (two blocks) and the KDF (scrypt/pbkdf2)
+dominates the cost by orders of magnitude, so a table-light python AES is
+plenty; the S-box and round constants are DERIVED from the GF(2^8) field
+structure at import rather than transcribed, and the implementation is
+pinned to the FIPS-197 known-answer vector in tests.
+"""
+
+from __future__ import annotations
+
+
+def _gmul(a: int, b: int) -> int:
+    """GF(2^8) multiply, reduction polynomial x^8+x^4+x^3+x+1 (0x11B)."""
+    r = 0
+    for _ in range(8):
+        if b & 1:
+            r ^= a
+        hi = a & 0x80
+        a = (a << 1) & 0xFF
+        if hi:
+            a ^= 0x1B
+        b >>= 1
+    return r
+
+
+def _ginv(a: int) -> int:
+    """Multiplicative inverse via a^254 (square-and-multiply)."""
+    if a == 0:
+        return 0
+    acc, base, e = 1, a, 254
+    while e:
+        if e & 1:
+            acc = _gmul(acc, base)
+        base = _gmul(base, base)
+        e >>= 1
+    return acc
+
+
+def _build_sbox() -> list:
+    sbox = []
+    for i in range(256):
+        c = _ginv(i)
+        x = c
+        for _ in range(4):
+            c = ((c << 1) | (c >> 7)) & 0xFF
+            x ^= c
+        sbox.append(x ^ 0x63)
+    return sbox
+
+
+_SBOX = _build_sbox()
+
+
+def _expand_key(key: bytes) -> list:
+    """AES-128 key schedule → 11 round keys of 16 bytes."""
+    words = [list(key[4 * i:4 * (i + 1)]) for i in range(4)]
+    rcon = 1
+    for i in range(4, 44):
+        w = list(words[i - 1])
+        if i % 4 == 0:
+            w = w[1:] + w[:1]
+            w = [_SBOX[b] for b in w]
+            w[0] ^= rcon
+            rcon = _gmul(rcon, 2)
+        words.append([a ^ b for a, b in zip(words[i - 4], w)])
+    return [sum((words[4 * r + c] for c in range(4)), [])
+            for r in range(11)]
+
+
+def _encrypt_block(block: bytes, round_keys: list) -> bytes:
+    s = [b ^ k for b, k in zip(block, round_keys[0])]
+    for rnd in range(1, 11):
+        s = [_SBOX[b] for b in s]
+        # ShiftRows on the column-major state: byte r + 4c moves left r.
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if rnd < 10:
+            t = []
+            for c in range(4):
+                col = s[4 * c:4 * c + 4]
+                t.extend([
+                    _gmul(col[0], 2) ^ _gmul(col[1], 3) ^ col[2] ^ col[3],
+                    col[0] ^ _gmul(col[1], 2) ^ _gmul(col[2], 3) ^ col[3],
+                    col[0] ^ col[1] ^ _gmul(col[2], 2) ^ _gmul(col[3], 3),
+                    _gmul(col[0], 3) ^ col[1] ^ col[2] ^ _gmul(col[3], 2),
+                ])
+            s = t
+        s = [b ^ k for b, k in zip(s, round_keys[rnd])]
+    return bytes(s)
+
+
+def aes128_ctr(key16: bytes, iv: bytes, data: bytes) -> bytes:
+    """CTR keystream XOR (the IV is the initial big-endian counter block,
+    matching ``cryptography``'s ``modes.CTR`` semantics)."""
+    if len(key16) != 16 or len(iv) != 16:
+        raise ValueError("AES-128-CTR needs 16-byte key and IV")
+    rks = _expand_key(key16)
+    counter = int.from_bytes(iv, "big")
+    out = bytearray()
+    for off in range(0, len(data), 16):
+        ks = _encrypt_block(
+            counter.to_bytes(16, "big"), rks)
+        counter = (counter + 1) % (1 << 128)
+        chunk = data[off:off + 16]
+        out.extend(b ^ k for b, k in zip(chunk, ks))
+    return bytes(out)

@@ -13,7 +13,14 @@ import uuid as uuid_mod
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+# The container may not ship `cryptography`; keystores then fall back to
+# the vector-pinned pure-python AES (``aes_fallback``) — the KDF dominates
+# keystore cost, so this is a correctness seam, not a performance one.
+try:
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher, algorithms, modes)
+except ModuleNotFoundError:  # pragma: no cover - env dependent
+    Cipher = None
 
 
 class KeystoreError(ValueError):
@@ -45,6 +52,9 @@ def _derive_key(password: bytes, kdf: dict) -> bytes:
 
 
 def _aes128_ctr(key16: bytes, iv: bytes, data: bytes) -> bytes:
+    if Cipher is None:
+        from .aes_fallback import aes128_ctr
+        return aes128_ctr(key16, iv, data)
     c = Cipher(algorithms.AES(key16), modes.CTR(iv)).encryptor()
     return c.update(data) + c.finalize()
 

@@ -1,0 +1,221 @@
+"""Device-batched KZG verification: barycentric blob evaluation as a
+VPU-shaped Fr kernel + the pairing equation reduced through the existing
+TPU Miller loop.
+
+Two device programs:
+
+1. :func:`eval_blobs` — p_i(z_i) for B blobs at once.  The barycentric sum
+       p(z) = (z^W - 1)/W · Σ_j f_j·ω_j/(z - ω_j)
+   is elementwise Fr work over a (B, W) grid: one batched Fermat-ladder
+   inversion (the only sequential part, a 255-step ``lax.scan`` shared by
+   every lane), two batched ``mont_mul`` passes, and a log₂W tree-sum —
+   exactly the many-independent-lanes shape the 16-bit-limb representation
+   was built for (:mod:`.fr_limb`, same layout as the base-field
+   ``limb_field``).  In-domain challenges (z = ω_j) resolve through the
+   masked select, not a host branch.
+
+2. :func:`verify_blob_kzg_proof_batch_device` — every blob contributes two
+   pairing lanes with FIXED G2 sides,
+
+       e(r_i·(C_i - y_i·G1 + z_i·Q_i), -G2) · e(r_i·Q_i, X2)  == 1  (∏ i)
+
+   padded to a power of two and fused through
+   :func:`lighthouse_tpu.crypto.limb_pairing.multi_pairing_is_one`: B
+   blobs cost 2B batched Miller lanes and ONE shared final exponentiation
+   — the same product-of-pairings amortization the BLS backend uses.  The
+   host's role is only the per-lane scalar muls (4 G1 muls/blob) and the
+   Fiat-Shamir transcript.
+
+Stage timings land in :data:`LAST_KZG_TIMINGS` and the metrics registry
+(``kzg_*`` histograms) for the bench row.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..common.metrics import observe
+from ..crypto import curve as C
+from ..crypto import limb_field as LF
+from ..crypto import limb_pairing as LP
+from . import fr_limb as FL
+from .fr import BLS_MODULUS
+from .trusted_setup import TrustedSetup
+
+# Stage decomposition of the last batch verify (bench.py reads this, the
+# LAST_COLD_TIMINGS idiom).
+LAST_KZG_TIMINGS: Dict[str, float] = {}
+
+
+def device_default() -> bool:
+    """Route batches to the device only on a real TPU backend — on CPU the
+    Miller-scan compile dwarfs the work (same policy as the BLS
+    backend's ``_use_pallas``).  LIGHTHOUSE_TPU_KZG_DEVICE=1/0 forces."""
+    import os
+    env = os.environ.get("LIGHTHOUSE_TPU_KZG_DEVICE")
+    if env is not None:
+        return env not in ("0", "false", "")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Barycentric evaluation kernel
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(3,))
+def _eval_kernel(f: jnp.ndarray, z: jnp.ndarray, roots: jnp.ndarray,
+                 width: int) -> jnp.ndarray:
+    """f: (B, W, 17) Montgomery evals; z: (B, 17); roots: (W, 17).
+    Returns (B, 17) Montgomery p_i(z_i)."""
+    d = FL.sub(z[:, None, :], roots[None, :, :])           # (B, W, 17)
+    hit = FL.is_zero(d)                                    # (B, W)
+    dinv = FL.inv(d)                                       # inv(0) = 0
+    terms = FL.mont_mul(FL.mont_mul(f, roots[None]), dinv)
+    # Modular tree-sum over W (add() keeps the lazy < 2N invariant).
+    acc = terms
+    n = width
+    while n > 1:
+        n //= 2
+        acc = FL.add(acc[:, :n, :], acc[:, n:2 * n, :])
+    acc = acc[:, 0, :]
+    # (z^W - 1)/W via log2(W) squarings.
+    zw = z
+    for _ in range(width.bit_length() - 1):
+        zw = FL.mont_mul(zw, zw)
+    w_inv = jnp.asarray(FL.to_mont(
+        pow(width, BLS_MODULUS - 2, BLS_MODULUS)))
+    factor = FL.mont_mul(FL.sub(zw, jnp.asarray(FL.ONE_MONT)), w_inv)
+    out = FL.mont_mul(acc, factor)
+    # In-domain challenge: p(ω_j) = f_j (the hit lane's evaluation; at
+    # most one root can match, so a masked tree-sum selects it).
+    fhit = FL.select(hit, f, jnp.zeros_like(f))
+    n = width
+    while n > 1:
+        n //= 2
+        fhit = FL.add(fhit[:, :n, :], fhit[:, n:2 * n, :])
+    return FL.select(jnp.any(hit, axis=1), fhit[:, 0, :], out)
+
+
+_ROOTS_CACHE: Dict[int, np.ndarray] = {}
+
+
+def _roots_limbs(setup: TrustedSetup) -> np.ndarray:
+    limbs = _ROOTS_CACHE.get(setup.width)
+    if limbs is None:
+        limbs = FL.to_mont_array(setup.roots)
+        _ROOTS_CACHE[setup.width] = limbs
+    return limbs
+
+
+def eval_blobs(polys, zs, setup: TrustedSetup) -> list:
+    """Batched p_i(z_i) for B polynomials (lists of Fr ints) at B points.
+    Host↔device conversion at the edges, ints in and out."""
+    B = len(polys)
+    if B == 0:
+        return []
+    f = FL.to_mont_array(polys)                    # (B, W, 17)
+    z = FL.to_mont_array(zs)                       # (B, 17)
+    out = _eval_kernel(jnp.asarray(f), jnp.asarray(z),
+                       jnp.asarray(_roots_limbs(setup)), setup.width)
+    return [int(v) for v in FL.from_mont_array(np.asarray(out))]
+
+
+# ---------------------------------------------------------------------------
+# Fused batch verification
+# ---------------------------------------------------------------------------
+
+def _g1_proj_limbs(points) -> np.ndarray:
+    """Affine host points → (B, 3, 26) Montgomery projective lanes
+    (identity → Z = 0, which the pairing masks to 1)."""
+    out = np.zeros((len(points), 3, LF.LIMBS), np.uint32)
+    for i, p in enumerate(points):
+        if p is None:
+            continue
+        out[i, 0] = LF.to_mont(p[0])
+        out[i, 1] = LF.to_mont(p[1])
+        out[i, 2] = LF.to_mont(1)
+    return out
+
+
+def _g2_proj_limbs(points) -> np.ndarray:
+    out = np.zeros((len(points), 3, 2, LF.LIMBS), np.uint32)
+    for i, p in enumerate(points):
+        if p is None:
+            continue
+        (x0, x1), (y0, y1) = p
+        out[i, 0, 0] = LF.to_mont(x0)
+        out[i, 0, 1] = LF.to_mont(x1)
+        out[i, 1, 0] = LF.to_mont(y0)
+        out[i, 1, 1] = LF.to_mont(y1)
+        out[i, 2, 0] = LF.to_mont(1)
+    return out
+
+
+def verify_blob_kzg_proof_batch_device(blobs, commitments, proofs,
+                                       setup: TrustedSetup) -> bool:
+    """B blobs → one device round-trip: eval kernel for the y_i, then 2B
+    Miller lanes + shared final exponentiation.  Same accept/reject set as
+    :func:`.kzg.verify_blob_kzg_proof_batch_host` (cross-checked in tests
+    and ``scripts/validate_pairing_kernels.py --kzg``)."""
+    from . import kzg as K
+    if not (len(blobs) == len(commitments) == len(proofs)):
+        raise K.KzgError("batch length mismatch")
+    if not blobs:
+        return True
+    t0 = time.perf_counter()
+    cpts = [K.bytes_to_kzg_commitment(c) for c in commitments]
+    qpts = [K.bytes_to_kzg_proof(q) for q in proofs]
+    polys = [K.blob_to_polynomial(b, setup.width) for b in blobs]
+    zs = [K.compute_challenge(b, c, setup.width)
+          for b, c in zip(blobs, commitments)]
+    t_chal = time.perf_counter()
+    ys = eval_blobs(polys, zs, setup)
+    t_eval = time.perf_counter()
+    rs = K._rlc_powers(commitments, zs, ys, proofs, setup.width)
+    # Per-blob lanes with fixed G2 sides — the SAME per-claim group math
+    # as the host fold (one source of truth for accept/reject parity).
+    g1a, g1b = [], []
+    for cpt, z, y, qpt, r in zip(cpts, zs, ys, qpts, rs):
+        (a, _neg_g2), (b, _x2) = K._proof_pairs(cpt, z, y, qpt, setup, r=r)
+        g1a.append(a)
+        g1b.append(b)
+    B = len(blobs)
+    lanes = 1
+    while lanes < 2 * B:
+        lanes *= 2
+    g1_lanes = np.zeros((lanes, 3, LF.LIMBS), np.uint32)
+    g2_lanes = np.zeros((lanes, 3, 2, LF.LIMBS), np.uint32)
+    mask = np.zeros(lanes, bool)
+    g1_lanes[0:2 * B:2] = _g1_proj_limbs(g1a)
+    g1_lanes[1:2 * B:2] = _g1_proj_limbs(g1b)
+    neg_g2 = _g2_proj_limbs([C.g2_neg(C.G2_GEN)])[0]
+    x2 = _g2_proj_limbs([setup.g2_monomial[1]])[0]
+    g2_lanes[0:2 * B:2] = neg_g2
+    g2_lanes[1:2 * B:2] = x2
+    mask[:2 * B] = True
+    t_prep = time.perf_counter()
+    ok = bool(np.asarray(LP.multi_pairing_is_one(
+        jnp.asarray(g1_lanes), jnp.asarray(g2_lanes), jnp.asarray(mask))))
+    t_pair = time.perf_counter()
+    LAST_KZG_TIMINGS.clear()
+    LAST_KZG_TIMINGS.update({
+        "blobs": B,
+        "lanes": lanes,
+        "challenge_ms": round((t_chal - t0) * 1e3, 2),
+        "eval_ms": round((t_eval - t_chal) * 1e3, 2),
+        "lane_prep_ms": round((t_prep - t_eval) * 1e3, 2),
+        "pairing_ms": round((t_pair - t_prep) * 1e3, 2),
+    })
+    observe("kzg_eval_seconds", t_eval - t_chal)
+    observe("kzg_lane_prep_seconds", t_prep - t_eval)
+    observe("kzg_pairing_seconds", t_pair - t_prep)
+    return ok

@@ -1,0 +1,195 @@
+"""Batched Fr (BLS12-381 scalar field) arithmetic in 16-bit limbs.
+
+The scalar-field sibling of :mod:`lighthouse_tpu.crypto.limb_field` (the
+same VPU-shaped layout: little-endian 16-bit limbs in uint32 lanes,
+Montgomery residues, lazy < 2N values, batched over leading axes) sized
+for the 255-bit modulus: 17 limbs, R = 2^272 ≈ 2^17·N.  The headroom is
+smaller than the base field's 2^35 but the same bounds go through:
+mont_mul's output (T + mN)/R < 4N²/R + N < 2N because 4N/R < 2^-15.
+
+Consumed by the barycentric blob-evaluation kernel
+(:func:`.device.eval_blobs`); the pure-int helpers in :mod:`.fr` are the
+semantics oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .fr import BLS_MODULUS as N_INT
+
+LIMB_BITS = 16
+LIMBS = 17
+MASK = np.uint32(0xFFFF)
+R_BITS = LIMB_BITS * LIMBS          # 272
+R_INT = 1 << R_BITS
+R_MOD_N = R_INT % N_INT
+RINV_INT = pow(R_INT, -1, N_INT)
+NPRIME_INT = (-pow(N_INT, -1, R_INT)) % R_INT
+
+# MSB-first exponent bits for the Fermat inversion ladder a^(N-2).
+N_MINUS_2_BITS = np.array([int(b) for b in bin(N_INT - 2)[2:]],
+                          dtype=np.int32)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    if not 0 <= x < R_INT:
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (LIMB_BITS * i)) & 0xFFFF for i in range(LIMBS)],
+                    dtype=np.uint32)
+
+
+def limbs_to_int(limbs: np.ndarray) -> int:
+    limbs = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(limbs))
+
+
+N_LIMBS = int_to_limbs(N_INT)
+N2_LIMBS = int_to_limbs(2 * N_INT)
+_NPRIME_LIMBS = int_to_limbs(NPRIME_INT)
+
+
+def to_mont(x: int) -> np.ndarray:
+    return int_to_limbs((x % N_INT) * R_MOD_N % N_INT)
+
+
+def from_mont(limbs: np.ndarray) -> int:
+    return limbs_to_int(limbs) * RINV_INT % N_INT
+
+
+def to_mont_array(xs) -> np.ndarray:
+    """Nested sequence/array of python ints → (..., 17) Montgomery limbs."""
+    arr = np.asarray(xs, dtype=object)
+    flat = [to_mont(int(x)) for x in arr.reshape(-1)]
+    out = np.stack(flat) if flat else np.zeros((0, LIMBS), np.uint32)
+    return out.reshape(arr.shape + (LIMBS,))
+
+
+def from_mont_array(limbs: np.ndarray) -> np.ndarray:
+    arr = np.asarray(limbs)
+    flat = arr.reshape(-1, LIMBS)
+    out = np.empty(flat.shape[0], dtype=object)
+    for i in range(flat.shape[0]):
+        out[i] = from_mont(flat[i])
+    return out.reshape(arr.shape[:-1])
+
+
+ZERO = np.zeros(LIMBS, dtype=np.uint32)
+ONE_MONT = to_mont(1)
+
+
+# ---------------------------------------------------------------------------
+# Device ops (batched over leading dims; limb axis = -1) — the exact
+# structure of limb_field with Fr constants; see that module for the
+# bound-by-bound reasoning.
+# ---------------------------------------------------------------------------
+
+def _carry_u32(x: jnp.ndarray) -> jnp.ndarray:
+    out = []
+    carry = jnp.zeros_like(x[..., 0])
+    for i in range(LIMBS):
+        v = x[..., i] + carry
+        out.append(v & MASK)
+        carry = v >> np.uint32(LIMB_BITS)
+    return jnp.stack(out, axis=-1)
+
+
+def _carry_i32(x: jnp.ndarray) -> jnp.ndarray:
+    out = []
+    carry = jnp.zeros_like(x[..., 0])
+    for i in range(LIMBS):
+        v = x[..., i] + carry
+        out.append(v & jnp.int32(0xFFFF))
+        carry = v >> 16
+    return jnp.stack(out, axis=-1).astype(jnp.uint32)
+
+
+def _cond_sub(x: jnp.ndarray, k_limbs: np.ndarray) -> jnp.ndarray:
+    d = x.astype(jnp.int32) - jnp.asarray(k_limbs, jnp.int32)
+    out = []
+    carry = jnp.zeros_like(d[..., 0])
+    for i in range(LIMBS):
+        v = d[..., i] + carry
+        out.append(v & jnp.int32(0xFFFF))
+        carry = v >> 16
+    d_norm = jnp.stack(out, axis=-1).astype(jnp.uint32)
+    return jnp.where((carry == 0)[..., None], d_norm, x)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _cond_sub(_carry_u32(a + b), N2_LIMBS)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    d = a.astype(jnp.int32) + jnp.asarray(N2_LIMBS, jnp.int32) \
+        - b.astype(jnp.int32)
+    return _cond_sub(_carry_i32(d), N2_LIMBS)
+
+
+def _band_columns(a: jnp.ndarray, b: jnp.ndarray, ncols: int) -> jnp.ndarray:
+    prod = a[..., :, None] * b[..., None, :]
+    lo = prod & MASK
+    hi = prod >> np.uint32(LIMB_BITS)
+    nd = lo.ndim - 2
+    parts = []
+    for i in range(LIMBS):
+        width = min(LIMBS, ncols - i)
+        if width > 0:
+            parts.append(jnp.pad(lo[..., i, :width],
+                                 [(0, 0)] * nd + [(i, ncols - i - width)]))
+        width = min(LIMBS, ncols - i - 1)
+        if width > 0:
+            parts.append(jnp.pad(hi[..., i, :width],
+                                 [(0, 0)] * nd + [(i + 1,
+                                                   ncols - i - 1 - width)]))
+    return jnp.sum(jnp.stack(parts), axis=0)
+
+
+def _carry_cols(t: jnp.ndarray, ncols: int, keep_carry: bool) -> jnp.ndarray:
+    out = []
+    carry = jnp.zeros_like(t[..., 0])
+    for i in range(ncols):
+        v = t[..., i] + carry
+        out.append(v & MASK)
+        carry = v >> np.uint32(LIMB_BITS)
+    if keep_carry:
+        out.append(carry)
+    return jnp.stack(out, axis=-1)
+
+
+def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched a·b·R⁻¹ mod N; normalized < 2N in, < 2N out."""
+    t = _band_columns(a, b, 2 * LIMBS)
+    t_low = _carry_cols(t[..., :LIMBS], LIMBS, keep_carry=False)
+    m = _carry_cols(_band_columns(t_low, jnp.asarray(_NPRIME_LIMBS), LIMBS),
+                    LIMBS, keep_carry=False)
+    u = _band_columns(m, jnp.asarray(N_LIMBS), 2 * LIMBS)
+    s = _carry_cols(t + u, 2 * LIMBS, keep_carry=True)
+    return s[..., LIMBS:2 * LIMBS]
+
+
+def select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(mask[..., None], a, b)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """Exact zero test for lazy values < 4N."""
+    out = None
+    for k in range(4):
+        eq = jnp.all(a == jnp.asarray(int_to_limbs(k * N_INT)), axis=-1)
+        out = eq if out is None else (out | eq)
+    return out
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Batched Fermat inversion a^(N-2) (scanned ladder); inv(0) = 0."""
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+
+    def body(acc, bit):
+        acc = mont_mul(acc, acc)
+        return select(bit.astype(bool), mont_mul(acc, a), acc), None
+
+    acc, _ = jax.lax.scan(body, one, jnp.asarray(N_MINUS_2_BITS))
+    return acc

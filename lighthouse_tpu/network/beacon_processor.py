@@ -29,6 +29,10 @@ from ..common.metrics import REGISTRY
 class WorkType(str, Enum):
     """Priority order = declaration order (`mod.rs:978` match order)."""
     ChainSegment = "chain_segment"
+    # Sidecars outrank blocks: their verification is cheap and a block's
+    # import is gated on them, so draining sidecars first avoids a
+    # needless unavailable→fetch round-trip for same-burst deliveries.
+    GossipBlobSidecar = "gossip_blob_sidecar"
     GossipBlock = "gossip_block"
     GossipAggregateBatch = "gossip_aggregate_batch"
     GossipAttestationBatch = "gossip_attestation_batch"
@@ -43,6 +47,7 @@ class WorkType(str, Enum):
 QUEUE_SPECS: Dict[WorkType, Tuple[int, bool, int]] = {
     WorkType.ChainSegment: (64, False, 1),
     WorkType.GossipBlock: (1024, False, 1),
+    WorkType.GossipBlobSidecar: (1024, False, 1),
     WorkType.GossipAggregateBatch: (4096, True, 64),
     WorkType.GossipAttestationBatch: (16384, True, 64),
     WorkType.Rpc: (1024, False, 1),

@@ -169,13 +169,24 @@ class SyncingChain:
 
     def _process(self, node, batch: BatchInfo) -> bool:
         """Import the batch as a chain segment.  An EMPTY batch is valid
-        (skipped slots); corrupt/unimportable blocks fail the batch."""
-        from ..beacon_chain import BlockError, BlockIsAlreadyKnown
+        (skipped slots); corrupt/unimportable blocks fail the batch.
+
+        Deneb: a blob-carrying block raises BlobsUnavailable on first
+        import — fetch its sidecars by root (the range-sync blob flow)
+        and retry once; only a still-unavailable block fails the batch
+        (its server withheld data it advertised)."""
+        from ..beacon_chain import (
+            BlobsUnavailable, BlockError, BlockIsAlreadyKnown)
 
         for b in batch.blocks:
             try:
                 node.chain.per_slot_task(int(b.message.slot))
-                node.chain.process_block(b)
+                try:
+                    node.chain.process_block(b)
+                except BlobsUnavailable:
+                    if not node._fetch_blobs(b):
+                        return False
+                    node.chain.process_block(b)
             except BlockIsAlreadyKnown:
                 continue
             except BlockError:

@@ -15,12 +15,20 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
-from ..beacon_chain import BeaconChain, BlockError, ParentUnknown
+from ..beacon_chain import (
+    BeaconChain,
+    BlobSidecarError,
+    BlobsUnavailable,
+    BlockError,
+    ParentUnknown,
+)
 from ..common.logging import Logger, test_logger
 from .beacon_processor import BeaconProcessor, WorkEvent, WorkType
 
 # Gossip topic names (`lighthouse_network/src/types/topics.rs:11-26`).
 TOPIC_BLOCK = "beacon_block"
+TOPIC_BLOB_SIDECAR = "blob_sidecar_{}"
+BLOB_SIDECAR_SUBNET_COUNT = 6
 TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
 TOPIC_ATTESTATION_SUBNET = "beacon_attestation_{}"
 TOPIC_EXIT = "voluntary_exit"
@@ -58,6 +66,13 @@ class BlocksByRangeRequest:
     count: int
 
 
+@dataclass
+class BlobSidecarsByRangeRequest:
+    """`BlobSidecarsByRange` (deneb p2p `rpc` addition)."""
+    start_slot: int
+    count: int
+
+
 class NetworkNode:
     """One node: chain + processor + router + sync
     (``beacon_node/network/src/router/`` + ``sync/``)."""
@@ -89,11 +104,25 @@ class NetworkNode:
         self._subnet_handlers: dict[int, Callable] = {}
         self._sync_handler = self._on_gossip_sync_messages
         bus.subscribe(TOPIC_SYNC_COMMITTEE, self._sync_handler)
+        # Blob sidecar subnets: every node subscribes to all of them (the
+        # deneb p2p spec makes the 6 blob subnets mandatory for full
+        # nodes, unlike the sampled attestation subnets).
+        self._blob_handler = self._on_gossip_blob_sidecar
+        for subnet in range(BLOB_SIDECAR_SUBNET_COUNT):
+            bus.subscribe(TOPIC_BLOB_SIDECAR.format(subnet),
+                          self._blob_handler)
 
     # -- publishing ----------------------------------------------------------
 
-    def publish_block(self, signed_block) -> None:
-        """Broadcast-then-self-import (`http_api/publish_blocks.rs`)."""
+    def publish_block(self, signed_block, blob_sidecars=()) -> None:
+        """Broadcast-then-self-import (`http_api/publish_blocks.rs`).
+
+        A Deneb proposer hands its blobs in here: sidecars gossip FIRST
+        (and outrank blocks in the processor queues), so both this node's
+        and every subscriber's availability cache is primed before the
+        block hits the import gate."""
+        for sc in blob_sidecars:
+            self.publish_blob_sidecar(sc)
         self.bus.publish(TOPIC_BLOCK, signed_block,
                          exclude=self._block_handler)
         self._on_gossip_block(signed_block)
@@ -101,6 +130,14 @@ class NetworkNode:
     def publish_attestations(self, atts: List) -> None:
         self.bus.publish(TOPIC_AGGREGATE, atts, exclude=self._att_handler)
         self._on_gossip_attestation(atts)
+
+    def publish_blob_sidecar(self, sidecar) -> None:
+        """Blob sidecar → its index's subnet topic + local availability
+        cache (proposers publish sidecars alongside the block)."""
+        topic = TOPIC_BLOB_SIDECAR.format(
+            int(sidecar.index) % BLOB_SIDECAR_SUBNET_COUNT)
+        self.bus.publish(topic, sidecar, exclude=self._blob_handler)
+        self._on_gossip_blob_sidecar(sidecar)
 
     # -- sync-committee gossip ------------------------------------------------
 
@@ -197,6 +234,29 @@ class NetworkNode:
                 WorkType.GossipAttestationBatch, att,
                 self._process_attestation_batch))
 
+    def _on_gossip_blob_sidecar(self, sidecar) -> None:
+        self.processor.submit(WorkEvent(
+            WorkType.GossipBlobSidecar, sidecar,
+            self._process_blob_sidecar))
+
+    def _process_blob_sidecar(self, sidecar) -> None:
+        da = self.chain.data_availability
+        try:
+            block_root = da.put_sidecar(sidecar)
+        except BlobSidecarError as e:
+            self.log.warn("blob sidecar rejected",
+                          index=int(sidecar.index), reason=str(e))
+            return
+        # A block already verified and parked on this sidecar resumes the
+        # moment its last blob lands (the availability cache's
+        # Availability::Available transition).
+        parked = da.peek_executed_block(block_root)
+        if parked is not None and not da.missing_indices(
+                parked.signed_block, block_root):
+            self.processor.defer(WorkEvent(
+                WorkType.GossipBlock, parked.signed_block,
+                self._process_block), 0.0)
+
     def _process_block(self, signed_block) -> None:
         slot = int(signed_block.message.slot)
         self.chain.per_slot_task(max(slot, self.chain.current_slot()))
@@ -209,6 +269,15 @@ class NetworkNode:
             # retry via the reprocess queue.
             self.log.debug("unknown parent; looking up", slot=slot)
             if self._parent_lookup(signed_block) or self._range_sync(slot):
+                self.processor.defer(WorkEvent(
+                    WorkType.GossipBlock, signed_block,
+                    self._process_block), 0.0)
+        except BlobsUnavailable:
+            # The block is fully verified but its blobs haven't arrived:
+            # fetch the missing sidecars by root from peers, then retry
+            # (the `block_lookups` single-block blob request flow).
+            self.log.debug("blobs unavailable; fetching", slot=slot)
+            if self._fetch_blobs(signed_block):
                 self.processor.defer(WorkEvent(
                     WorkType.GossipBlock, signed_block,
                     self._process_block), 0.0)
@@ -252,6 +321,73 @@ class NetworkNode:
                 out.append(block)
         return out
 
+    # -- blob sidecar Req/Resp (deneb p2p) -----------------------------------
+
+    def blob_sidecars_by_range(self, req: BlobSidecarsByRangeRequest) -> List:
+        """Serve `BlobSidecarsByRange` along the canonical chain,
+        ascending (slot, index) like the wire protocol requires."""
+        out = []
+        root = self.chain.head.root
+        while root in self.chain.fork_choice.proto.indices:
+            block = self.chain.store.get_block(root)
+            if block is None:
+                break
+            slot = int(block.message.slot)
+            if slot < req.start_slot:
+                break
+            if slot < req.start_slot + req.count:
+                out.extend(self.chain.store.get_blob_sidecars(root))
+            root = bytes(block.message.parent_root)
+        out.sort(key=lambda sc: (
+            int(sc.signed_block_header.message.slot), int(sc.index)))
+        return out
+
+    def blob_sidecars_by_root(self, ids: List) -> List:
+        """Serve `BlobSidecarsByRoot`; ``ids`` is (block_root, index)
+        pairs (the BlobIdentifier shape)."""
+        out = []
+        for block_root, index in ids:
+            sc = self.chain.store.get_blob_sidecar(bytes(block_root),
+                                                   int(index))
+            if sc is not None:
+                out.append(sc)
+        return out
+
+    def _fetch_blobs(self, signed_block) -> bool:
+        """Pull the block's missing sidecars by root from the best peers;
+        True once the availability cache can satisfy the block."""
+        from .peer_manager import PeerAction
+        chain = self.chain
+        block_root = signed_block.message.tree_hash_root()
+        for peer in self.peer_manager.best_peers(self.peers):
+            if not hasattr(peer, "blob_sidecars_by_root"):
+                continue
+            missing = chain.data_availability.missing_indices(
+                signed_block, block_root)
+            if not missing:
+                return True
+            try:
+                got = peer.blob_sidecars_by_root(
+                    [(block_root, i) for i in missing])
+            except Exception:
+                self.peer_manager.report(peer, PeerAction.TIMEOUT)
+                continue
+            for sc in got:
+                try:
+                    chain.data_availability.put_sidecar(sc)
+                except BlobSidecarError:
+                    # Served a sidecar that fails verification — as
+                    # malicious as a bad block.
+                    self.peer_manager.report(peer,
+                                             PeerAction.INVALID_MESSAGE)
+                    break
+            if not chain.data_availability.missing_indices(signed_block,
+                                                           block_root):
+                self.peer_manager.report(peer, PeerAction.SYNC_SERVED)
+                return True
+        return not chain.data_availability.missing_indices(signed_block,
+                                                           block_root)
+
     def head_slot(self) -> int:
         """Peer-handle protocol (shared with the wire transport's
         :class:`~.transport.RemotePeer`)."""
@@ -293,7 +429,15 @@ class NetworkNode:
                 for b in reversed(chain_segment):
                     try:
                         self.chain.per_slot_task(int(b.message.slot))
-                        self.chain.process_block(b)
+                        try:
+                            self.chain.process_block(b)
+                        except BlobsUnavailable:
+                            # The recovered segment's block carries blobs
+                            # we never saw on gossip: fetch by root (the
+                            # same peers that served the blocks), retry.
+                            if not self._fetch_blobs(b):
+                                raise
+                            self.chain.process_block(b)
                         ok = True
                     except BlockError:
                         pass

@@ -527,6 +527,9 @@ def compute_timestamp_at_slot(state, spec, preset) -> int:
 def process_execution_payload(state, body, fork, preset, spec, T,
                               payload_verifier=None) -> None:
     payload = body.execution_payload
+    if fork >= ForkName.DENEB and len(body.blob_kzg_commitments) > \
+            preset.MAX_BLOBS_PER_BLOCK:
+        raise BlockProcessingError("too many blob commitments")
     if is_merge_transition_complete(state):
         if payload.parent_hash != state.latest_execution_payload_header.block_hash:
             raise BlockProcessingError("payload parent hash mismatch")
@@ -559,6 +562,9 @@ def process_execution_payload(state, body, fork, preset, spec, T,
     if fork >= ForkName.CAPELLA:
         wd_list_t = type(payload).FIELDS["withdrawals"]
         kw["withdrawals_root"] = wd_list_t.hash_tree_root(payload.withdrawals)
+    if fork >= ForkName.DENEB:
+        kw["blob_gas_used"] = payload.blob_gas_used
+        kw["excess_blob_gas"] = payload.excess_blob_gas
     state.latest_execution_payload_header = header_cls(**kw)
 
 

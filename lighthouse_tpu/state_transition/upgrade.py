@@ -78,8 +78,22 @@ def upgrade_to_capella(state, epoch, preset, spec, T):
     return new
 
 
+def upgrade_to_deneb(state, epoch, preset, spec, T):
+    new = T.BeaconStateDeneb()
+    _carry_common(state, new, T)
+    new.fork = T.Fork(previous_version=state.fork.current_version,
+                      current_version=spec.deneb_fork_version,
+                      epoch=epoch)
+    old_h = state.latest_execution_payload_header
+    new.latest_execution_payload_header = T.ExecutionPayloadHeaderDeneb(
+        **{f: getattr(old_h, f) for f in type(old_h).FIELDS},
+        blob_gas_used=0, excess_blob_gas=0)
+    return new
+
+
 _UPGRADES = {
     ForkName.ALTAIR: upgrade_to_altair,
     ForkName.BELLATRIX: upgrade_to_bellatrix,
     ForkName.CAPELLA: upgrade_to_capella,
+    ForkName.DENEB: upgrade_to_deneb,
 }

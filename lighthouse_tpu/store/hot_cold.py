@@ -105,6 +105,33 @@ class HotColdDB:
                 return self.T.signed_block_cls(fork).deserialize(data[1:])
         return None
 
+    # -- blob sidecars (Deneb data availability) -----------------------------
+
+    def put_blob_sidecar(self, block_root: bytes, index: int,
+                         sidecar) -> None:
+        """Keyed block_root ‖ index (`hot_cold_store.rs` put_blobs; this
+        stores sidecars individually so by-root requests for a subset
+        avoid decoding the full 6-blob bundle)."""
+        self.kv.put(DBColumn.BlobSidecar,
+                    bytes(block_root) + bytes([index]),
+                    type(sidecar).serialize(sidecar))
+
+    def get_blob_sidecar(self, block_root: bytes, index: int):
+        data = self.kv.get(DBColumn.BlobSidecar,
+                           bytes(block_root) + bytes([index]))
+        if data is None:
+            return None
+        return self.T.BlobSidecar.deserialize(data)
+
+    def get_blob_sidecars(self, block_root: bytes) -> List:
+        """All stored sidecars for a block, ascending index."""
+        out = []
+        for index in range(self.preset.MAX_BLOBS_PER_BLOCK):
+            sc = self.get_blob_sidecar(block_root, index)
+            if sc is not None:
+                out.append(sc)
+        return out
+
     # -- states --------------------------------------------------------------
 
     def put_state(self, state_root: bytes, state,

@@ -32,6 +32,7 @@ class DBColumn(str, Enum):
     BeaconRestorePoint = "brp"
     ColdBlock = "cbk"
     ColdState = "cst"
+    BlobSidecar = "blb"
 
 
 class KeyValueStore:

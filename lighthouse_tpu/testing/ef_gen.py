@@ -33,7 +33,7 @@ from .ef_runner import _epoch_steps
 from .harness import StateHarness
 
 GEN_FORKS = (ForkName.PHASE0, ForkName.ALTAIR, ForkName.BELLATRIX,
-             ForkName.CAPELLA)
+             ForkName.CAPELLA, ForkName.DENEB)
 
 
 def _write(path: str, data: bytes) -> None:
@@ -693,7 +693,8 @@ def _gen_transition(root: str) -> None:
     from .ef_runner import _FORK_EPOCH_ATTR, _PRE_FORK
     from .harness import StateHarness
 
-    for post in (ForkName.ALTAIR, ForkName.BELLATRIX, ForkName.CAPELLA):
+    for post in (ForkName.ALTAIR, ForkName.BELLATRIX, ForkName.CAPELLA,
+                 ForkName.DENEB):
         pre_fork = _PRE_FORK[post]
         attr = _FORK_EPOCH_ATTR[post]
         fork_epoch = 1

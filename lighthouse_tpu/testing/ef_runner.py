@@ -427,10 +427,12 @@ def _case_bls(ctx: CaseCtx, handler: str) -> None:
 
 _PRE_FORK = {ForkName.ALTAIR: ForkName.PHASE0,
              ForkName.BELLATRIX: ForkName.ALTAIR,
-             ForkName.CAPELLA: ForkName.BELLATRIX}
+             ForkName.CAPELLA: ForkName.BELLATRIX,
+             ForkName.DENEB: ForkName.CAPELLA}
 _FORK_EPOCH_ATTR = {ForkName.ALTAIR: "altair_fork_epoch",
                     ForkName.BELLATRIX: "bellatrix_fork_epoch",
-                    ForkName.CAPELLA: "capella_fork_epoch"}
+                    ForkName.CAPELLA: "capella_fork_epoch",
+                    ForkName.DENEB: "deneb_fork_epoch"}
 
 
 def _case_transition(ctx: CaseCtx, handler: str) -> None:

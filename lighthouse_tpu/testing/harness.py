@@ -275,6 +275,7 @@ class StateHarness:
                     attester_slashings: list = (),
                     voluntary_exits: list = (),
                     bls_to_execution_changes: list = (),
+                    blob_kzg_commitments: list = (),
                     sync_participation: float = 1.0,
                     compute_state_root: bool = True,
                     pre_merge: bool = False,
@@ -360,6 +361,9 @@ class StateHarness:
         if fork >= ForkName.CAPELLA:
             body_kw["bls_to_execution_changes"] = list(
                 bls_to_execution_changes)
+        if fork >= ForkName.DENEB:
+            body_kw["blob_kzg_commitments"] = [
+                bytes(c) for c in blob_kzg_commitments]
 
         body = T.body_cls(fork)(**body_kw)
         block = T.block_cls(fork)(

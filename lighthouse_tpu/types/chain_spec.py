@@ -54,6 +54,7 @@ class ForkName(str, enum.Enum):
     ALTAIR = "altair"
     BELLATRIX = "bellatrix"
     CAPELLA = "capella"
+    DENEB = "deneb"
 
     @property
     def order(self) -> int:
@@ -81,7 +82,8 @@ class ForkName(str, enum.Enum):
 
 
 _FORK_ORDER = {ForkName.PHASE0: 0, ForkName.ALTAIR: 1,
-               ForkName.BELLATRIX: 2, ForkName.CAPELLA: 3}
+               ForkName.BELLATRIX: 2, ForkName.CAPELLA: 3,
+               ForkName.DENEB: 4}
 
 
 @dataclass
@@ -102,6 +104,8 @@ class ChainSpec:
     bellatrix_fork_epoch: int | None = 144896
     capella_fork_version: bytes = bytes([3, 0, 0, 0])
     capella_fork_epoch: int | None = 194048
+    deneb_fork_version: bytes = bytes([4, 0, 0, 0])
+    deneb_fork_epoch: int | None = 269568
 
     # Time parameters
     seconds_per_slot: int = 12
@@ -147,6 +151,7 @@ class ChainSpec:
             ForkName.ALTAIR: self.altair_fork_version,
             ForkName.BELLATRIX: self.bellatrix_fork_version,
             ForkName.CAPELLA: self.capella_fork_version,
+            ForkName.DENEB: self.deneb_fork_version,
         }[fork]
 
     def fork_epoch(self, fork: ForkName) -> int | None:
@@ -155,12 +160,14 @@ class ChainSpec:
             ForkName.ALTAIR: self.altair_fork_epoch,
             ForkName.BELLATRIX: self.bellatrix_fork_epoch,
             ForkName.CAPELLA: self.capella_fork_epoch,
+            ForkName.DENEB: self.deneb_fork_epoch,
         }[fork]
 
     def fork_name_at_epoch(self, epoch: int) -> ForkName:
         """``ChainSpec::fork_name_at_epoch`` (``chain_spec.rs``)."""
         current = ForkName.PHASE0
-        for fork in (ForkName.ALTAIR, ForkName.BELLATRIX, ForkName.CAPELLA):
+        for fork in (ForkName.ALTAIR, ForkName.BELLATRIX, ForkName.CAPELLA,
+                     ForkName.DENEB):
             fe = self.fork_epoch(fork)
             if fe is not None and fe != FAR_FUTURE_EPOCH and epoch >= fe:
                 current = fork
@@ -168,7 +175,7 @@ class ChainSpec:
 
     def next_fork(self, fork: ForkName) -> ForkName | None:
         order = [ForkName.PHASE0, ForkName.ALTAIR, ForkName.BELLATRIX,
-                 ForkName.CAPELLA]
+                 ForkName.CAPELLA, ForkName.DENEB]
         i = order.index(fork)
         return order[i + 1] if i + 1 < len(order) else None
 
@@ -237,6 +244,8 @@ class ChainSpec:
             bellatrix_fork_epoch=FAR_FUTURE_EPOCH,
             capella_fork_version=bytes([3, 0, 0, 1]),
             capella_fork_epoch=FAR_FUTURE_EPOCH,
+            deneb_fork_version=bytes([4, 0, 0, 1]),
+            deneb_fork_epoch=FAR_FUTURE_EPOCH,
             seconds_per_slot=6,
             shard_committee_period=64,
             eth1_follow_distance=16,
@@ -251,7 +260,8 @@ class ChainSpec:
         updates = {}
         for f, attr in ((ForkName.ALTAIR, "altair_fork_epoch"),
                         (ForkName.BELLATRIX, "bellatrix_fork_epoch"),
-                        (ForkName.CAPELLA, "capella_fork_epoch")):
+                        (ForkName.CAPELLA, "capella_fork_epoch"),
+                        (ForkName.DENEB, "deneb_fork_epoch")):
             if fork >= f:
                 updates[attr] = 0
         return replace(self, **updates)

@@ -239,12 +239,24 @@ class SpecTypes:
             transactions: List(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD)
             withdrawals: List(Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD)
 
+        class ExecutionPayloadDeneb(_PayloadCommon):
+            transactions: List(Transaction, p.MAX_TRANSACTIONS_PER_PAYLOAD)
+            withdrawals: List(Withdrawal, p.MAX_WITHDRAWALS_PER_PAYLOAD)
+            blob_gas_used: uint64
+            excess_blob_gas: uint64
+
         class ExecutionPayloadHeaderBellatrix(_PayloadCommon):
             transactions_root: Bytes32
 
         class ExecutionPayloadHeaderCapella(_PayloadCommon):
             transactions_root: Bytes32
             withdrawals_root: Bytes32
+
+        class ExecutionPayloadHeaderDeneb(_PayloadCommon):
+            transactions_root: Bytes32
+            withdrawals_root: Bytes32
+            blob_gas_used: uint64
+            excess_blob_gas: uint64
 
         # -- block bodies / blocks per fork ----------------------------------
 
@@ -274,6 +286,36 @@ class SpecTypes:
             bls_to_execution_changes: List(
                 SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)
 
+        # Deneb (EIP-4844): the body carries the blob KZG commitments; the
+        # blobs themselves travel as BlobSidecars outside the block.
+        KZGCommitment = Bytes48
+        KZGProof = Bytes48
+        Blob = ByteVector(p.BYTES_PER_BLOB)
+
+        class BeaconBlockBodyDeneb(_BodyCommon):
+            sync_aggregate: SyncAggregate
+            execution_payload: ExecutionPayloadDeneb
+            bls_to_execution_changes: List(
+                SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)
+            blob_kzg_commitments: List(
+                KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+
+        class BlobSidecar(Container):
+            """`deneb/p2p-interface.md` BlobSidecar: blob + proof bound to
+            a block via the header and the commitment inclusion branch."""
+            index: uint64
+            blob: Blob
+            kzg_commitment: KZGCommitment
+            kzg_proof: KZGProof
+            signed_block_header: SignedBeaconBlockHeader
+            kzg_commitment_inclusion_proof: Vector(
+                Bytes32, p.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)
+
+        class BlobIdentifier(Container):
+            """`BlobSidecarsByRoot` request element."""
+            block_root: Bytes32
+            index: uint64
+
         def _make_block(body_cls):
             class BeaconBlock(Container):
                 slot: uint64
@@ -292,6 +334,7 @@ class SpecTypes:
         BeaconBlockAltair, SignedBeaconBlockAltair = _make_block(BeaconBlockBodyAltair)
         BeaconBlockBellatrix, SignedBeaconBlockBellatrix = _make_block(BeaconBlockBodyBellatrix)
         BeaconBlockCapella, SignedBeaconBlockCapella = _make_block(BeaconBlockBodyCapella)
+        BeaconBlockDeneb, SignedBeaconBlockDeneb = _make_block(BeaconBlockBodyDeneb)
 
         # -- blinded blocks (builder flow) ------------------------------------
         # The payload is replaced by its header; because the header's
@@ -310,10 +353,20 @@ class SpecTypes:
             bls_to_execution_changes: List(
                 SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)
 
+        class BlindedBeaconBlockBodyDeneb(_BodyCommon):
+            sync_aggregate: SyncAggregate
+            execution_payload_header: ExecutionPayloadHeaderDeneb
+            bls_to_execution_changes: List(
+                SignedBLSToExecutionChange, p.MAX_BLS_TO_EXECUTION_CHANGES)
+            blob_kzg_commitments: List(
+                KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+
         BlindedBeaconBlockBellatrix, SignedBlindedBeaconBlockBellatrix = \
             _make_block(BlindedBeaconBlockBodyBellatrix)
         BlindedBeaconBlockCapella, SignedBlindedBeaconBlockCapella = \
             _make_block(BlindedBeaconBlockBodyCapella)
+        BlindedBeaconBlockDeneb, SignedBlindedBeaconBlockDeneb = \
+            _make_block(BlindedBeaconBlockBodyDeneb)
 
         # -- states per fork -------------------------------------------------
 
@@ -397,6 +450,13 @@ class SpecTypes:
             historical_summaries: List(
                 HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT)
 
+        class BeaconStateDeneb(_StateAltairCommon):
+            latest_execution_payload_header: ExecutionPayloadHeaderDeneb
+            next_withdrawal_index: uint64
+            next_withdrawal_validator_index: uint64
+            historical_summaries: List(
+                HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT)
+
         # -- publish ---------------------------------------------------------
 
         for k, v in list(locals().items()):
@@ -420,12 +480,16 @@ class SpecTypes:
             ForkName.CAPELLA: (BeaconStateCapella, BeaconBlockCapella,
                                SignedBeaconBlockCapella,
                                BeaconBlockBodyCapella),
+            ForkName.DENEB: (BeaconStateDeneb, BeaconBlockDeneb,
+                             SignedBeaconBlockDeneb, BeaconBlockBodyDeneb),
         }
         self._payload_by_fork = {
             ForkName.BELLATRIX: (ExecutionPayloadBellatrix,
                                  ExecutionPayloadHeaderBellatrix),
             ForkName.CAPELLA: (ExecutionPayloadCapella,
                                ExecutionPayloadHeaderCapella),
+            ForkName.DENEB: (ExecutionPayloadDeneb,
+                             ExecutionPayloadHeaderDeneb),
         }
         self._blinded_by_fork = {
             ForkName.BELLATRIX: (BlindedBeaconBlockBellatrix,
@@ -434,6 +498,9 @@ class SpecTypes:
             ForkName.CAPELLA: (BlindedBeaconBlockCapella,
                                SignedBlindedBeaconBlockCapella,
                                BlindedBeaconBlockBodyCapella),
+            ForkName.DENEB: (BlindedBeaconBlockDeneb,
+                             SignedBlindedBeaconBlockDeneb,
+                             BlindedBeaconBlockBodyDeneb),
         }
 
     # -- fork-indexed access (superstruct's common accessors) ---------------

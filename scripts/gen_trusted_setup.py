@@ -1,0 +1,57 @@
+"""Regenerate the framework's insecure KZG trusted setup and the pinned
+test vectors.
+
+    python scripts/gen_trusted_setup.py --width 4          # setup JSON
+    python scripts/gen_trusted_setup.py --width 4 --vectors
+
+Provenance: the setup is powers-of-tau for the PUBLIC
+``trusted_setup.INSECURE_TAU`` (sha256 of a fixed tag) — forgeable by
+construction, structurally identical to a ceremony transcript.  The
+``--width 4`` output is what is embedded as
+``trusted_setup.EMBEDDED_MINIMAL_JSON`` (test_kzg pins the equality);
+``--vectors`` prints the (blob, commitment, proof, z, y) tuple pinned in
+``tests/test_kzg.py``.
+"""
+
+import sys, os  # noqa: E401
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import hashlib
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=4)
+    ap.add_argument("--vectors", action="store_true",
+                    help="print the pinned test-vector tuple instead")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.kzg import fr, kzg as K
+    from lighthouse_tpu.kzg.trusted_setup import (
+        INSECURE_TAU, dump_trusted_setup, generate_insecure_setup)
+
+    setup = generate_insecure_setup(args.width)
+    if not args.vectors:
+        print(dump_trusted_setup(setup))
+        print(f"# tau = sha256('lighthouse-tpu insecure kzg tau') mod r "
+              f"= {hex(INSECURE_TAU)}", file=sys.stderr)
+        return
+
+    evals = [int.from_bytes(hashlib.sha256(
+        b"lighthouse-tpu kzg vector %d" % i).digest(), "big")
+        % fr.BLS_MODULUS for i in range(args.width)]
+    blob = K.polynomial_to_blob(evals)
+    cm = K.blob_to_kzg_commitment(blob, setup)
+    pf = K.compute_blob_kzg_proof(blob, cm, setup)
+    z = K.compute_challenge(blob, cm, args.width)
+    y = fr.evaluate_polynomial_in_evaluation_form(evals, z, setup.roots)
+    print("BLOB =", blob.hex())
+    print("COMMITMENT =", cm.hex())
+    print("PROOF =", pf.hex())
+    print("Z =", hex(z))
+    print("Y =", hex(y))
+
+
+if __name__ == "__main__":
+    main()

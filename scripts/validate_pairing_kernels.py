@@ -1,6 +1,62 @@
 import sys; sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 import time, numpy as np, jax, jax.numpy as jnp
 print("devices:", jax.devices(), flush=True)
+
+
+def validate_kzg(n_blobs: int, width: int) -> None:
+    """--kzg mode: the device KZG reduction (barycentric Fr kernel + 2
+    Miller lanes per blob) vs the host RLC fold, on random blobs — valid
+    batch, per-blob tamper, and proof-swap must all agree."""
+    import random
+    from lighthouse_tpu.kzg import device as D, kzg as K
+    from lighthouse_tpu.kzg.fr import BLS_MODULUS
+    from lighthouse_tpu.kzg.trusted_setup import verification_setup
+
+    t0 = time.time()
+    # Verifier-only setup: known-tau commit/prove + verify never read
+    # the width-sized g1_lagrange table.
+    setup = verification_setup(width)
+    rng = random.Random(0)
+    blobs, cms, pfs = [], [], []
+    for _ in range(n_blobs):
+        blob = K.polynomial_to_blob(
+            [rng.randrange(BLS_MODULUS) for _ in range(width)])
+        cm = K.blob_to_kzg_commitment(blob, setup)
+        blobs.append(blob); cms.append(cm)
+        pfs.append(K.compute_blob_kzg_proof(blob, cm, setup))
+    print(f"setup+fixtures ({n_blobs} blobs, width {width}):",
+          round(time.time() - t0, 2), "s", flush=True)
+
+    cases = [
+        ("valid", blobs, cms, pfs),
+        ("swapped_proofs", blobs, cms, list(reversed(pfs))),
+        ("tampered_blob", [blobs[0][:-32] + b"\x00" * 32] + blobs[1:],
+         cms, pfs),
+    ]
+    for name, bs, cs, ps in cases:
+        t0 = time.time()
+        dev = K.verify_blob_kzg_proof_batch(bs, cs, ps, setup,
+                                            use_device=True)
+        t_dev = time.time() - t0
+        t0 = time.time()
+        host = K.verify_blob_kzg_proof_batch(bs, cs, ps, setup,
+                                             use_device=False)
+        t_host = time.time() - t0
+        assert dev == host, f"{name}: device={dev} host={host} DISAGREE"
+        print(f"{name}: device={dev} ({round(t_dev, 2)}s) == host "
+              f"({round(t_host, 2)}s); stages={D.LAST_KZG_TIMINGS}",
+              flush=True)
+        assert dev == (name == "valid"), f"{name}: wrong verdict {dev}"
+    print("kzg device reduction == host fallback OK", flush=True)
+
+
+if "--kzg" in sys.argv:
+    i = sys.argv.index("--kzg")
+    n_blobs = int(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 4
+    width = int(sys.argv[i + 2]) if len(sys.argv) > i + 2 else 16
+    validate_kzg(n_blobs, width)
+    sys.exit(0)
+
 from lighthouse_tpu.crypto import curve as C, fields as F, pairing as HP
 from lighthouse_tpu.crypto import limb_field as LF, limb_tower as LT
 from lighthouse_tpu.crypto import pairing_kernel as PK

@@ -1,0 +1,46 @@
+"""Every lighthouse_tpu module must import under JAX_PLATFORMS=cpu.
+
+Off-TPU import breaks (a TPU-only symbol referenced at module scope, a
+renamed jax API, a kernel table built against a missing backend) have
+twice been found by the judge instead of tier-1 — the PR-1 `shard_map`
+import and `pltpu.CompilerParams` shims.  This walks the whole package so
+any module that cannot even import on CPU fails HERE, with its name.
+
+Import is also execution of module-level code (frobenius tables, limb
+constants, type factories), so this doubles as a smoke test that none of
+it asserts on CPU.
+"""
+
+import importlib
+import pkgutil
+
+import pytest
+
+import lighthouse_tpu
+
+
+def _all_modules():
+    mods = []
+    for info in pkgutil.walk_packages(lighthouse_tpu.__path__,
+                                      prefix="lighthouse_tpu."):
+        mods.append(info.name)
+    return sorted(mods)
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("name", _all_modules())
+def test_module_imports_on_cpu(name):
+    importlib.import_module(name)
+
+
+@pytest.mark.quick
+def test_walk_found_the_tree():
+    """The walker must actually see the package (an empty parametrize
+    list would green-wash every future import break)."""
+    mods = _all_modules()
+    assert len(mods) > 50
+    for expected in ("lighthouse_tpu.crypto.limb_pairing",
+                     "lighthouse_tpu.kzg.device",
+                     "lighthouse_tpu.beacon_chain.data_availability",
+                     "lighthouse_tpu.parallel"):
+        assert expected in mods, f"walker missed {expected}"
