@@ -32,9 +32,15 @@ Also measured (BASELINE rows 2-5 + latency tier):
   path); ``leaf_push_wait_ms``/``leaf_push_overlap_ms`` are the same
   split for the non-registry big-field leaf pushes
   (``merkle_levels_device``).
-- ``block_transition_ms`` — Capella block with 128 attestations applied
-  to a 2^14-validator mainnet state, per-phase (BASELINE row 3;
-  `lcli/src/transition_blocks.rs:229`).
+- ``block_transition_ms`` / ``block_transition_atts_per_s`` — Capella
+  block with 128 attestations applied to a 2^14-validator mainnet state,
+  per-phase (BASELINE row 3; `lcli/src/transition_blocks.rs:229`),
+  through the batched attestation path.
+- ``epoch_transition_ms`` — single-pass epoch processing at 2^20
+  validators with per-stage timings (context / justification /
+  inactivity / rewards / registry / slashings / effective-balance) plus
+  ``epoch_transition_stepwise_ms`` (the oracle path) and
+  ``epoch_shuffle_ms`` (whole-epoch committee shuffle).
 - ``op_pool_pack_100k_ms`` — max-cover packing over 100k pooled
   attestations (BASELINE row 5).
 - ``slasher_update_1m_ms`` — slasher min/max span-plane ingest for a
@@ -334,13 +340,83 @@ def _block_transition_bench() -> dict:
                           strategy=SignatureStrategy.NO_VERIFICATION)
             state.tree_hash_root()
             ts.append((time.perf_counter() - t0) * 1e3)
+        n_atts = len(signed.message.body.attestations)
         return {
             "block_transition_ms": round(min(ts), 1),
-            "block_transition_attestations":
-                len(signed.message.body.attestations),
+            "block_transition_attestations": n_atts,
+            "block_transition_atts_per_s":
+                round(n_atts / (min(ts) / 1e3), 1),
         }
     finally:
         bls.set_backend(prev_backend)
+
+
+def _epoch_transition_bench() -> dict:
+    """Single-pass epoch processing at registry scale (2^20 validators,
+    random participation), with the per-stage decomposition from
+    ``per_epoch.LAST_EPOCH_TIMINGS`` plus the stepwise-oracle time for the
+    trajectory and a whole-epoch committee-shuffle (CommitteeCache build)
+    row — the one-shot committee resolution the vectorized swap-or-not
+    shuffle buys."""
+    from lighthouse_tpu.state_transition import per_epoch as PE
+    from lighthouse_tpu.state_transition.committees import CommitteeCache
+    from lighthouse_tpu.types.chain_spec import ChainSpec, ForkName
+    from lighthouse_tpu.types.factory import spec_types
+    from lighthouse_tpu.types.presets import MAINNET
+    from lighthouse_tpu.types.validators import ValidatorRegistry
+
+    n = 1 << STATE_LOG2
+    rng = np.random.default_rng(7)
+    T = spec_types(MAINNET)
+    spec = ChainSpec.mainnet().with_forks_at_genesis(ForkName.CAPELLA)
+    state = T.state_cls(ForkName.CAPELLA)()
+    reg = ValidatorRegistry(n)
+    reg._n = n
+    reg.init_columns(
+        pubkey=rng.integers(0, 256, (n, 48), dtype=np.uint8),
+        withdrawal_credentials=rng.integers(0, 256, (n, 32), dtype=np.uint8),
+        effective_balance=np.full(n, 32 * 10 ** 9, dtype=np.uint64),
+        activation_epoch=np.zeros(n, dtype=np.uint64))
+    state.validators = reg
+    state.balances = np.full(n, 32 * 10 ** 9, dtype=np.uint64)
+    state.previous_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.current_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.inactivity_scores = np.zeros(n, dtype=np.uint64)
+    state.slot = 8 * 32 + 31
+    state.finalized_checkpoint = T.Checkpoint(epoch=6, root=b"\x01" * 32)
+    state.previous_justified_checkpoint = T.Checkpoint(epoch=6,
+                                                       root=b"\x01" * 32)
+    state.current_justified_checkpoint = T.Checkpoint(epoch=7,
+                                                      root=b"\x02" * 32)
+
+    ts, steps = [], []
+    for _ in range(RUNS):
+        s2 = state.copy()
+        t0 = time.perf_counter()
+        PE.process_epoch_single_pass(s2, ForkName.CAPELLA, MAINNET, spec, T)
+        ts.append((time.perf_counter() - t0) * 1e3)
+        s3 = state.copy()
+        t0 = time.perf_counter()
+        PE.process_epoch_stepwise(s3, ForkName.CAPELLA, MAINNET, spec, T)
+        steps.append((time.perf_counter() - t0) * 1e3)
+    stages = dict(PE.LAST_EPOCH_TIMINGS)
+    t0 = time.perf_counter()
+    CommitteeCache(state, 8, MAINNET)
+    shuffle_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "epoch_transition_ms": round(min(ts), 1),
+        "epoch_transition_stepwise_ms": round(min(steps), 1),
+        "epoch_validators": n,
+        "epoch_context_ms": round(stages.get("context_ms", 0), 2),
+        "epoch_justification_ms": round(stages.get("justification_ms", 0), 2),
+        "epoch_inactivity_ms": round(stages.get("inactivity_ms", 0), 2),
+        "epoch_rewards_ms": round(stages.get("rewards_ms", 0), 2),
+        "epoch_registry_ms": round(stages.get("registry_ms", 0), 2),
+        "epoch_slashings_ms": round(stages.get("slashings_ms", 0), 2),
+        "epoch_effective_balance_ms":
+            round(stages.get("effective_balance_ms", 0), 2),
+        "epoch_shuffle_ms": round(shuffle_ms, 1),
+    }
 
 
 def _op_pool_bench() -> dict:
@@ -487,6 +563,7 @@ _ROWS = [
     ("op_pool", _op_pool_bench, "op_pool_pack_100k"),
     ("slasher", _slasher_bench, "slasher_span_update_1m"),
     ("block", _block_transition_bench, "block_transition_128att"),
+    ("epoch", _epoch_transition_bench, "epoch_transition_2e%d" % STATE_LOG2),
     ("stages", _stage_split_bench, "bls_stage_split"),
     ("kzg", _kzg_bench, "kzg_batch_verify"),
     ("bls", _bls_bench, "bls_batch_verify_%d_sets" % N_SETS),

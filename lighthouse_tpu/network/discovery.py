@@ -107,18 +107,35 @@ class DiscoveryService:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def _recv_kind(self, kind: int) -> bytes | None:
+        """Receive until a frame of ``kind`` arrives or the socket times
+        out.  A PONG delayed past one round's timeout otherwise desyncs
+        every later round (the stale PONG answers the next FIND, and the
+        64-byte PONG read would truncate-and-drop a NODES datagram) —
+        the cause of the discovery-mesh flake under full-suite load."""
+        deadline = time.monotonic() + self.sock.gettimeout()
+        while time.monotonic() < deadline:
+            try:
+                data, _ = self.sock.recvfrom(65536)
+            except OSError:
+                return None
+            if data and data[0] == kind:
+                return data
+        return None
+
     def poll_once(self) -> List[Tuple[bytes, int, str]]:
         """One PING + FIND round; dials fresh records. Returns them."""
-        self.sock.sendto(
-            bytes([MSG_PING]) + self.node_id
-            + struct.pack("<H", self.tcp_port), self.boot_addr)
         try:
-            self.sock.recvfrom(64)  # PONG
+            self.sock.sendto(
+                bytes([MSG_PING]) + self.node_id
+                + struct.pack("<H", self.tcp_port), self.boot_addr)
+            if self._recv_kind(MSG_PONG) is None:
+                return []
             self.sock.sendto(bytes([MSG_FIND]), self.boot_addr)
-            data, _ = self.sock.recvfrom(65536)
+            data = self._recv_kind(MSG_NODES)
         except OSError:
             return []
-        if not data or data[0] != MSG_NODES:
+        if not data:
             return []
         (n,) = struct.unpack_from("<H", data, 1)
         fresh = []

@@ -484,19 +484,34 @@ class WireNetwork:
         already-connected peer: a Status round-trip identifies the remote
         before keeping the connection, so mutual discovery (A and B both
         seeing each other's record) converges on ~one connection per pair
-        instead of flooding every frame twice.  A simultaneous-dial race
-        can still leave a transient duplicate; gossip stays correct either
-        way via the seen-hash dedup in ``_flood``."""
+        instead of flooding every frame twice.
+
+        Duplicates resolve by node-id tie-break (libp2p's simultaneous-
+        dial rule): the LOWER node id keeps its outbound dial, the higher
+        id yields.  "Close my outbound whenever any conn already has this
+        peer_id" let A and B each treat the other's inbound as the
+        existing connection and close both sockets — a permanently
+        partitioned pair, since discovery never re-dials a known node id
+        (the boot-node mesh flake)."""
         peer = self.dial(port, host)
         peer.head_slot()  # Status: fills peer.peer_id
         pid = peer.peer_id
         if pid is not None:
-            dup = pid == self.node_id or any(
-                p is not peer and p.peer_id == pid
-                for p in self.node.peers)
-            if dup:
+            if pid == self.node_id:
                 peer._conn.close()
                 return None
+            dups = [p for p in self.node.peers
+                    if p is not peer and p.peer_id == pid]
+            if dups:
+                if self.node_id < pid:
+                    # Canonical dialer: keep this outbound, retire the
+                    # duplicate inbound conns (the remote closes the same
+                    # sockets from its side of the tie-break).
+                    for p in dups:
+                        p._conn.close()
+                else:
+                    peer._conn.close()
+                    return None
         return peer
 
     def discover(self, boot_host: str, boot_port: int,

@@ -16,7 +16,7 @@ from typing import Dict, Hashable, List, Optional, Tuple
 
 import numpy as np
 
-from .max_cover import maximum_cover
+from .max_cover import greedy_pack, maximum_cover
 
 __all__ = ["OperationPool", "AttMaxCover", "maximum_cover"]
 
@@ -25,21 +25,30 @@ class AttMaxCover:
     """Attestation candidate weighted by effective balances of the NEW
     attesters it would add (`attestation.rs` AttMaxCover; rewards are
     balance-proportional, so balance weight orders candidates the same
-    way as the reference's base-reward weight)."""
+    way as the reference's base-reward weight).  Coverage lives in flat
+    int64 arrays (``cover_elements`` — max_cover's packed fast path);
+    ``covering_set``/``update_covering_set`` keep the dict protocol for
+    external callers."""
 
     def __init__(self, att, fresh_indices: np.ndarray,
                  balances: np.ndarray):
         self.att = att
-        fresh = np.asarray(fresh_indices)
-        self._cover: Dict[int, int] = dict(
-            zip(fresh.tolist(), balances[fresh].tolist()))
+        self._elems = np.asarray(fresh_indices, dtype=np.int64)
+        self._weights = balances[self._elems].astype(np.int64)
+
+    def cover_elements(self):
+        return self._elems, self._weights
 
     def covering_set(self) -> Dict[int, int]:
-        return self._cover
+        return dict(zip(self._elems.tolist(), self._weights.tolist()))
 
     def update_covering_set(self, covered: Dict[int, int]) -> None:
-        for k in covered:
-            self._cover.pop(k, None)
+        if not covered:
+            return
+        dead = np.fromiter(covered.keys(), np.int64, len(covered))
+        keep = ~np.isin(self._elems, dead)
+        self._elems = self._elems[keep]
+        self._weights = self._weights[keep]
 
 
 @dataclass
@@ -246,66 +255,47 @@ def _pack_columnar(candidates, balances, seen_cur, seen_prev,
                    limit: int) -> List:
     """Columnar greedy max-cover — same greedy (heaviest-first, earliest
     tie-break, winners' coverage struck from the rest) as
-    :func:`max_cover.maximum_cover`, expressed over padded (N, W) index
-    matrices so a backlogged pool packs in numpy time, not Python-dict
-    time (the 100k-candidate BASELINE row-5 shape).  Equivalence with the
-    dict path is asserted in tests."""
+    :func:`max_cover.maximum_cover`, expressed over flat CSR arrays feeding
+    :func:`max_cover.greedy_pack`'s packed-bitset core so a backlogged pool
+    packs in numpy time, not Python-dict time (the 100k-candidate BASELINE
+    row-5 shape; the earlier padded (N, W) matrix form spent half its time
+    materialising ~100 MB gathers).  Freshness is resolved per candidate
+    epoch against the packed participation state in one flat gather.
+    Equivalence with the dict path is asserted in tests."""
     N = len(candidates)
     ws = np.fromiter((len(s.committee) for s, _ in candidates),
                      np.int64, N)
-    W = int(ws.max())
-    # Scatter the ragged committees/bits into the padded matrices in one
-    # flat assignment (a 100k-iteration python fill loop was ~half the
-    # pack time at the BASELINE row-5 shape).
-    flat_comm = np.concatenate([np.asarray(s.committee, np.int64)
-                                for s, _ in candidates])
+    bounds = np.zeros(N + 1, dtype=np.int64)
+    np.cumsum(ws, out=bounds[1:])
+    # int32 ids: the flat passes below are memory-bandwidth bound.
+    flat_comm = np.concatenate(
+        [np.asarray(s.committee) for s, _ in candidates],
+        dtype=np.int32, casting="unsafe")
     flat_bit = np.concatenate(
         [np.asarray(s.bits[:w], bool)
          for (s, _), w in zip(candidates, ws)])
-    rows = np.repeat(np.arange(N), ws)
-    cols = np.arange(ws.sum()) - np.repeat(np.cumsum(ws) - ws, ws)
-    comms = np.zeros((N, W), np.int64)
-    bits = np.zeros((N, W), bool)
-    comms[rows, cols] = flat_comm
-    bits[rows, cols] = flat_bit
+    # Mask by aggregation bits FIRST so the freshness gathers touch only
+    # set members (~half the flat length); candidate segment bounds track
+    # through the compactions via searchsorted/cumsum instead of a
+    # full-length candidate-id column.
+    attesting = np.flatnonzero(flat_bit)
+    att_bounds = np.searchsorted(attesting, bounds)
+    att_comm = flat_comm[attesting]
     is_cur = np.fromiter((cur for _, cur in candidates), bool, N)
-    seen = np.empty((N, W), bool)
-    seen[is_cur] = seen_cur[comms[is_cur]]
-    seen[~is_cur] = seen_prev[comms[~is_cur]]
-    live = bits & ~seen
-    elem_w = balances[comms].astype(np.int64)
-    weights = (elem_w * live).sum(1)
-    # Element → candidate reverse index (flat, grouped by element).
-    # Within-group order is irrelevant downstream (groups feed a
-    # np.unique), so the default quicksort beats the stable mergesort
-    # that dominated the 100k-candidate profile.
-    lv = live.ravel()
-    flat_c = np.repeat(np.arange(N), W)[lv]
-    flat_e = comms.ravel()[lv]
-    order = np.argsort(flat_e)
-    sorted_e = flat_e[order]
-    sorted_c = flat_c[order]
-    covered = np.zeros(balances.shape[0], bool)
-    chosen: List = []
-    for _ in range(limit):
-        b = int(np.argmax(weights))
-        if weights[b] <= 0:
-            break
-        chosen.append(candidates[b][0])
-        elems = comms[b][live[b] & ~covered[comms[b]]]
-        covered[elems] = True
-        weights[b] = -1
-        lo = np.searchsorted(sorted_e, elems, "left")
-        hi = np.searchsorted(sorted_e, elems, "right")
-        if elems.size:
-            aff = np.unique(np.concatenate(
-                [sorted_c[l:h] for l, h in zip(lo, hi)]))
-            aff = aff[weights[aff] > 0]
-            if aff.size:
-                sub = comms[aff]
-                alive = live[aff] & ~covered[sub]
-                weights[aff] = (elem_w[aff] * alive).sum(1)
-    return chosen
+    att_cur = np.repeat(is_cur, np.diff(att_bounds))
+    seen_flat = np.empty(attesting.shape[0], dtype=bool)
+    seen_flat[att_cur] = seen_cur[att_comm[att_cur]]
+    not_cur = ~att_cur
+    seen_flat[not_cur] = seen_prev[att_comm[not_cur]]
+    fresh = ~seen_flat
+    cfs = np.zeros(attesting.shape[0] + 1, dtype=np.int64)
+    np.cumsum(fresh, out=cfs[1:])
+    offsets = cfs[att_bounds]
+    flat_e = att_comm[fresh]
+    flat_w = balances[flat_e].astype(np.int64)
+    chosen, _, _ = greedy_pack(flat_e, flat_w, offsets, balances.shape[0],
+                               limit)
+    return [candidates[b][0] for b in chosen]
 
 
 def bench_pack_attestations(n_atts: int, n_validators: int = 1 << 20,

@@ -74,6 +74,12 @@ class SigAccumulator:
         self.strategy = strategy
         self.sets: list[B.SignatureSet] = []
 
+    @property
+    def wants_sets(self) -> bool:
+        """False under NO_VERIFICATION: callers on the batched path skip
+        building (and pubkey-decompressing) sets that would be dropped."""
+        return self.strategy != SignatureStrategy.NO_VERIFICATION
+
     def add(self, sset: B.SignatureSet | None) -> None:
         if sset is None:
             return
@@ -169,6 +175,14 @@ def process_eth1_data(state, eth1_data, preset) -> None:
 # Operations
 # ---------------------------------------------------------------------------
 
+def _batched_atts_enabled() -> bool:
+    """Vectorized attestation processing knob: on unless
+    ``LIGHTHOUSE_TPU_BATCHED_ATTS=0`` (the scalar spec path is the
+    differential oracle — see README "State transition")."""
+    import os
+    return os.environ.get("LIGHTHOUSE_TPU_BATCHED_ATTS", "1") != "0"
+
+
 def process_operations(state, body, fork, preset, spec, T, acc,
                        pubkey_cache) -> None:
     expected_deposits = min(
@@ -184,9 +198,14 @@ def process_operations(state, body, fork, preset, spec, T, acc,
     for op in body.attester_slashings:
         process_attester_slashing(state, op, fork, preset, spec, acc,
                                   pubkey_cache)
-    for op in body.attestations:
-        process_attestation(state, op, fork, preset, spec, T, acc,
-                            pubkey_cache)
+    atts = list(body.attestations)
+    if fork != ForkName.PHASE0 and len(atts) > 1 and _batched_atts_enabled():
+        process_attestations_batched(state, atts, fork, preset, spec, T, acc,
+                                     pubkey_cache)
+    else:
+        for op in atts:
+            process_attestation(state, op, fork, preset, spec, T, acc,
+                                pubkey_cache)
     for op in body.deposits:
         process_deposit(state, op, preset, spec, T)
     for op in body.voluntary_exits:
@@ -282,10 +301,9 @@ def get_attestation_participation_flag_indices(state, data, inclusion_delay,
     return flags
 
 
-def process_attestation(state, attestation, fork, preset, spec, T, acc,
-                        pubkey_cache) -> None:
-    data = attestation.data
-    cur, prev = current_epoch(state, preset), previous_epoch(state, preset)
+def _check_attestation_data(state, data, cur: int, prev: int, preset) -> None:
+    """Shared per-attestation data validation (scalar and batched paths
+    raise the same errors in the same order)."""
     if data.target.epoch not in (prev, cur):
         raise BlockProcessingError("attestation target epoch out of range")
     if data.target.epoch != compute_epoch_at_slot(data.slot,
@@ -297,6 +315,13 @@ def process_attestation(state, attestation, fork, preset, spec, T, acc,
     if data.index >= get_committee_count_per_slot(state, data.target.epoch,
                                                   preset):
         raise BlockProcessingError("committee index out of range")
+
+
+def process_attestation(state, attestation, fork, preset, spec, T, acc,
+                        pubkey_cache) -> None:
+    data = attestation.data
+    cur, prev = current_epoch(state, preset), previous_epoch(state, preset)
+    _check_attestation_data(state, data, cur, prev, preset)
 
     indices = get_attesting_indices(state, data, attestation.aggregation_bits,
                                     preset)
@@ -353,6 +378,100 @@ def process_attestation(state, attestation, fork, preset, spec, T, acc,
     proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
                                    * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
     proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    increase_balance(state, get_beacon_proposer_index(state, preset),
+                     proposer_reward)
+
+
+def process_attestations_batched(state, attestations, fork, preset, spec, T,
+                                 acc, pubkey_cache) -> None:
+    """All of a block's attestations in ONE columnar pass (altair+).
+
+    The scalar path walks one attestation and one participant at a time;
+    here per-attestation *data* validation stays scalar (cheap, identical
+    errors) while the per-participant work — freshness tests, participation
+    flag sets, proposer-reward numerators — becomes vectorized compares and
+    scatter-ORs over the concatenated attesting-index column, grouped by
+    (participation epoch, flag).  Freshness ordering across attestations in
+    the block is preserved exactly: within each (epoch, flag) group, only a
+    validator's FIRST occurrence (in block order) can be fresh, and
+    pre-block freshness comes from the unmodified participation column.
+    Per-attestation integer division of the proposer numerator is kept
+    (sum-then-divide would round differently).  The scalar
+    :func:`process_attestation` is the differential oracle
+    (``LIGHTHOUSE_TPU_BATCHED_ATTS=0``).
+    """
+    cur, prev = current_epoch(state, preset), previous_epoch(state, preset)
+    n = len(state.validators)
+    total = get_total_active_balance(state, preset)
+    base_u64 = base_rewards_column(state, total, preset)
+    # int64 numerator accumulation needs headroom for n participants ×
+    # the SUM of flag weights (one attestation can earn all three flags
+    # per fresh validator); un-spec-ably large effective balances
+    # (hand-crafted states) take the exact Python-int scalar path.
+    if int(base_u64.max(initial=0)) * sum(PARTICIPATION_FLAG_WEIGHTS) \
+            * max(n, 1) >= 1 << 62:
+        for op in attestations:
+            process_attestation(state, op, fork, preset, spec, T, acc,
+                                pubkey_cache)
+        return
+    base = base_u64.astype(np.int64)
+
+    idx_parts: list[np.ndarray] = []
+    counts = np.empty(len(attestations), dtype=np.int64)
+    flag_bits = np.empty(len(attestations), dtype=np.uint8)
+    is_cur = np.empty(len(attestations), dtype=bool)
+    for a, attestation in enumerate(attestations):
+        data = attestation.data
+        _check_attestation_data(state, data, cur, prev, preset)
+        indices = get_attesting_indices(
+            state, data, attestation.aggregation_bits, preset)
+        if acc.wants_sets:
+            acc.add(sigs.indexed_attestation_signature_set(
+                state, indices, attestation.signature, data, pubkey_cache,
+                preset))
+        flags = get_attestation_participation_flag_indices(
+            state, data, state.slot - data.slot, preset)
+        idx_parts.append(indices.astype(np.int64))
+        counts[a] = indices.shape[0]
+        flag_bits[a] = sum(1 << f for f in flags)
+        is_cur[a] = data.target.epoch == cur
+
+    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
+    seg = np.repeat(np.arange(len(attestations)), counts)
+    flags_flat = np.repeat(flag_bits, counts)
+    is_cur_flat = np.repeat(is_cur, counts)
+
+    cur_part = _full_column(state.current_epoch_participation, n, np.uint8)
+    prev_part = _full_column(state.previous_epoch_participation, n, np.uint8)
+    numerators = np.zeros(len(attestations), dtype=np.int64)
+    for epoch_is_cur, part in ((True, cur_part), (False, prev_part)):
+        epoch_sel = is_cur_flat == epoch_is_cur
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            bit = np.uint8(1 << flag_index)
+            pos = np.flatnonzero(epoch_sel & ((flags_flat & bit) != 0))
+            if pos.size == 0:
+                continue
+            sub = idx[pos]
+            pre_fresh = (part[sub] & bit) == 0
+            # First block-order occurrence per validator within this group.
+            _, first = np.unique(sub, return_index=True)
+            first_occurrence = np.zeros(sub.shape[0], dtype=bool)
+            first_occurrence[first] = True
+            fresh = pos[pre_fresh & first_occurrence]
+            np.add.at(numerators, seg[fresh], base[idx[fresh]] * weight)
+            part[sub] |= bit
+
+    # Write back only the columns the block touched (the scalar path only
+    # expands/reassigns the column of each attestation's target epoch).
+    if is_cur.any():
+        state.current_epoch_participation = cur_part
+    if not is_cur.all():
+        state.previous_epoch_participation = prev_part
+
+    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_reward = sum(
+        int(num) // proposer_reward_denominator for num in numerators)
     increase_balance(state, get_beacon_proposer_index(state, preset),
                      proposer_reward)
 
@@ -481,18 +600,47 @@ def process_sync_aggregate(state, aggregate, preset, spec, T, acc) -> None:
     proposer_reward = (participant_reward * PROPOSER_WEIGHT
                        // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
 
-    cache = _state_pubkey_cache(state)
     proposer = get_beacon_proposer_index(state, preset)
     bits = np.asarray(aggregate.sync_committee_bits, dtype=bool)
+    reg = state.validators
+    members = np.empty(len(state.current_sync_committee.pubkeys),
+                       dtype=np.int64)
     for i, pk in enumerate(state.current_sync_committee.pubkeys):
-        idx = cache.index_of(state.validators, pk)
+        idx = reg.pubkey_index(bytes(pk))
         if idx is None:
             raise BlockProcessingError("sync committee pubkey not in registry")
-        if bits[i]:
-            increase_balance(state, idx, participant_reward)
-            increase_balance(state, proposer, proposer_reward)
-        else:
-            decrease_balance(state, idx, participant_reward)
+        members[i] = idx
+
+    # One scatter pass instead of 512 scalar balance ops.  The scalar loop's
+    # only order-sensitivity is decrease-saturation at ~zero balances (a
+    # validator can appear multiple times in the committee, mixing + and −);
+    # when any involved balance could saturate, or the totals strain u64,
+    # fall back to the exact sequential loop.
+    n_bal = state.balances.shape[0]
+    bal = np.asarray(state.balances, dtype=np.uint64)
+    n_participants = int(bits.sum())
+    safe = (participant_reward < 1 << 44
+            and proposer_reward < 1 << 44
+            and proposer < n_bal
+            and int(members.max(initial=0)) < n_bal
+            and int(bal.max(initial=0)) < 1 << 62)
+    if safe:
+        inc_cnt = np.bincount(members[bits], minlength=n_bal).astype(np.int64)
+        dec_cnt = np.bincount(members[~bits], minlength=n_bal).astype(np.int64)
+        need = dec_cnt * participant_reward
+        safe = bool(np.all(bal.astype(np.int64) >= need))
+    if safe:
+        delta = (inc_cnt - dec_cnt) * participant_reward
+        delta[proposer] += n_participants * proposer_reward
+        state.balances = (bal.astype(np.int64) + delta).astype(np.uint64)
+    else:
+        for i in range(members.shape[0]):
+            idx = int(members[i])
+            if bits[i]:
+                increase_balance(state, idx, participant_reward)
+                increase_balance(state, proposer, proposer_reward)
+            else:
+                decrease_balance(state, idx, participant_reward)
 
 
 # ---------------------------------------------------------------------------
@@ -568,8 +716,9 @@ def process_execution_payload(state, body, fork, preset, spec, T,
     state.latest_execution_payload_header = header_cls(**kw)
 
 
-def get_expected_withdrawals(state, preset) -> list:
-    """Capella withdrawal sweep (spec ``get_expected_withdrawals``)."""
+def get_expected_withdrawals_scalar(state, preset) -> list:
+    """Capella withdrawal sweep (spec ``get_expected_withdrawals``) — the
+    scalar per-validator oracle for the vectorized sweep below."""
     epoch = current_epoch(state, preset)
     withdrawal_index = state.next_withdrawal_index
     validator_index = state.next_withdrawal_validator_index
@@ -596,6 +745,42 @@ def get_expected_withdrawals(state, preset) -> list:
                                 balance - preset.MAX_EFFECTIVE_BALANCE))
             withdrawal_index += 1
         validator_index = (validator_index + 1) % n
+    return withdrawals
+
+
+def get_expected_withdrawals(state, preset) -> list:
+    """Vectorized withdrawal sweep: eligibility for every swept validator in
+    a handful of column compares, then the first
+    ``MAX_WITHDRAWALS_PER_PAYLOAD`` hits in sweep order.  Bit-identical to
+    :func:`get_expected_withdrawals_scalar` (asserted in tests)."""
+    epoch = current_epoch(state, preset)
+    reg = state.validators
+    n = len(reg)
+    if n == 0:
+        return []
+    sweep = min(n, preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    order = ((state.next_withdrawal_validator_index
+              + np.arange(sweep, dtype=np.int64)) % n)
+    bal_col = np.asarray(state.balances, dtype=np.uint64)
+    balance = np.where(order < bal_col.shape[0],
+                       bal_col[np.minimum(order, bal_col.shape[0] - 1)]
+                       if bal_col.shape[0] else np.uint64(0),
+                       np.uint64(0))
+    creds = reg.col("withdrawal_credentials")[order]
+    has_eth1 = creds[:, 0] == ETH1_ADDRESS_WITHDRAWAL_PREFIX[0]
+    wd_epoch = reg.col("withdrawable_epoch")[order]
+    eff = reg.col("effective_balance")[order]
+    max_eb = np.uint64(preset.MAX_EFFECTIVE_BALANCE)
+    full = has_eth1 & (wd_epoch <= np.uint64(epoch)) & (balance > 0)
+    partial = has_eth1 & (eff == max_eb) & (balance > max_eb)
+    hits = np.flatnonzero(full | partial)[:preset.MAX_WITHDRAWALS_PER_PAYLOAD]
+    withdrawals = []
+    wi = state.next_withdrawal_index
+    for k, t in enumerate(hits):
+        amount = int(balance[t]) if full[t] \
+            else int(balance[t]) - preset.MAX_EFFECTIVE_BALANCE
+        withdrawals.append((wi + k, int(order[t]),
+                            creds[t, 12:].tobytes(), amount))
     return withdrawals
 
 

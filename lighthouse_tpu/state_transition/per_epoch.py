@@ -36,10 +36,8 @@ from .helpers import (
     has_flag,
     is_active_at,
     previous_epoch,
-    sha,
 )
 from .mutations import initiate_validator_exit, proportional_slashing_multiplier
-from .shuffle import compute_shuffled_index
 
 
 @dataclass
@@ -386,24 +384,18 @@ def process_participation_flag_updates(state) -> None:
 
 
 def get_next_sync_committee_indices(state, preset) -> list[int]:
-    """Spec sampling: shuffled candidates + effective-balance acceptance."""
+    """Spec sampling: shuffled candidates + effective-balance acceptance,
+    vectorized in committee-sized chunks (the scalar per-candidate loop
+    cost ``2 * SHUFFLE_ROUND_COUNT`` hashes per candidate)."""
     epoch = current_epoch(state, preset) + 1
     from .helpers import get_active_validator_indices, get_seed
+    from .shuffle import sample_committee_candidates
     active = get_active_validator_indices(state.validators, epoch)
-    count = len(active)
     seed = get_seed(state, epoch, Domain.SYNC_COMMITTEE, preset)
     eff = state.validators.col("effective_balance")
-    out: list[int] = []
-    i = 0
-    while len(out) < preset.SYNC_COMMITTEE_SIZE:
-        shuffled = compute_shuffled_index(i % count, count, seed,
-                                          preset.SHUFFLE_ROUND_COUNT)
-        cand = int(active[shuffled])
-        random_byte = sha(seed + (i // 32).to_bytes(8, "little"))[i % 32]
-        if int(eff[cand]) * 255 >= preset.MAX_EFFECTIVE_BALANCE * random_byte:
-            out.append(cand)
-        i += 1
-    return out
+    return sample_committee_candidates(
+        eff, active.astype(np.int64), seed, preset.SHUFFLE_ROUND_COUNT,
+        preset.MAX_EFFECTIVE_BALANCE, needed=preset.SYNC_COMMITTEE_SIZE)
 
 
 def get_next_sync_committee(state, preset, T):
@@ -422,6 +414,215 @@ def process_sync_committee_updates(state, preset, T) -> None:
     if next_epoch % preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
         state.current_sync_committee = state.next_sync_committee
         state.next_sync_committee = get_next_sync_committee(state, preset, T)
+
+
+# ---------------------------------------------------------------------------
+# Single-pass epoch processing
+# ---------------------------------------------------------------------------
+
+#: Stage timings (ms) of the most recent single-pass epoch transition —
+#: bench.py's ``epoch_transition_ms`` decomposition.
+LAST_EPOCH_TIMINGS: dict = {}
+
+
+def _single_pass_enabled() -> bool:
+    """Fused-epoch knob: on unless ``LIGHTHOUSE_TPU_SINGLE_PASS_EPOCH=0``
+    (the stepwise path is the differential oracle)."""
+    import os
+    return os.environ.get("LIGHTHOUSE_TPU_SINGLE_PASS_EPOCH", "1") != "0"
+
+
+def _epoch_device_enabled() -> bool:
+    """``LIGHTHOUSE_TPU_EPOCH_DEVICE=1`` routes the fused rewards/inactivity
+    sweep through the jitted device kernel (per_epoch_device)."""
+    import os
+    return os.environ.get("LIGHTHOUSE_TPU_EPOCH_DEVICE", "0") == "1"
+
+
+@dataclass
+class EpochContext:
+    """Everything the altair+ epoch steps re-derive from the registry,
+    computed once — Lighthouse's single-pass ``EpochProcessingCache`` idea:
+    each column is read one time and every mask is shared."""
+    prev: int
+    cur: int
+    active_prev: np.ndarray
+    active_cur: np.ndarray
+    eligible: np.ndarray
+    not_slashed: np.ndarray
+    prev_part: np.ndarray
+    cur_part: np.ndarray
+    unslashed_prev: tuple          # per participation flag, previous epoch
+    target_cur: np.ndarray
+    eff: np.ndarray
+    total_active_balance: int
+    base: np.ndarray
+
+
+def build_epoch_context(state, preset) -> EpochContext:
+    reg = state.validators
+    n = len(reg)
+    cur = current_epoch(state, preset)
+    prev = previous_epoch(state, preset)
+    act = reg.col("activation_epoch")
+    ext = reg.col("exit_epoch")
+    wd = reg.col("withdrawable_epoch")
+    slashed = reg.col("slashed")
+    eff = reg.col("effective_balance")
+    active_prev = (act <= prev) & (prev < ext)
+    active_cur = (act <= cur) & (cur < ext)
+    eligible = active_prev | (slashed & (prev + 1 < wd))
+    not_slashed = ~slashed
+    prev_part = _full_column(state.previous_epoch_participation, n, np.uint8)
+    cur_part = _full_column(state.current_epoch_participation, n, np.uint8)
+    unslashed_prev = tuple(
+        active_prev & ((prev_part & np.uint8(1 << f)) != 0) & not_slashed
+        for f in range(len(PARTICIPATION_FLAG_WEIGHTS)))
+    target_cur = (active_cur
+                  & ((cur_part & np.uint8(1 << TIMELY_TARGET_FLAG_INDEX)) != 0)
+                  & not_slashed)
+    total = max(int(eff[active_cur].sum()),
+                preset.EFFECTIVE_BALANCE_INCREMENT)
+    per_inc = base_reward_per_increment(total, preset)
+    base = (eff // np.uint64(preset.EFFECTIVE_BALANCE_INCREMENT)
+            ) * np.uint64(per_inc)
+    return EpochContext(
+        prev=prev, cur=cur, active_prev=active_prev, active_cur=active_cur,
+        eligible=eligible, not_slashed=not_slashed, prev_part=prev_part,
+        cur_part=cur_part, unslashed_prev=unslashed_prev,
+        target_cur=target_cur, eff=eff, total_active_balance=total,
+        base=base)
+
+
+def _participating_balance_from(eff: np.ndarray, mask: np.ndarray,
+                                preset) -> int:
+    return max(int(eff[mask].sum()), preset.EFFECTIVE_BALANCE_INCREMENT)
+
+
+def _fused_inactivity_and_rewards(state, fork: ForkName, preset, spec,
+                                  ctx: EpochContext, summary: EpochSummary,
+                                  timings: dict) -> None:
+    """``process_inactivity_updates`` + ``process_rewards_and_penalties`` as
+    one columnar sweep over the shared context.  Bit-identical to the
+    sequential steps (incl. u64 wrap/floor-division semantics): the score
+    update runs first in-register, and the inactivity penalty reads the NEW
+    scores, exactly as the stepwise order does."""
+    import time
+    n = len(state.validators)
+    if ctx.cur == GENESIS_EPOCH:
+        return
+    in_leak = is_in_inactivity_leak(state, preset)
+    target_prev = ctx.unslashed_prev[TIMELY_TARGET_FLAG_INDEX]
+
+    if _epoch_device_enabled():
+        from . import per_epoch_device as PED
+        if PED.fused_sweep(state, fork, preset, spec, ctx, summary,
+                           in_leak, timings):
+            return
+
+    t0 = time.perf_counter()
+    scores = _full_column(state.inactivity_scores, n, np.uint64)
+    dec = np.minimum(np.uint64(1), scores)
+    scores = np.where(ctx.eligible & target_prev, scores - dec, scores)
+    scores = np.where(ctx.eligible & ~target_prev,
+                      scores + np.uint64(spec.inactivity_score_bias), scores)
+    if not in_leak:
+        rec = np.minimum(np.uint64(spec.inactivity_score_recovery_rate),
+                         scores)
+        scores = np.where(ctx.eligible, scores - rec, scores)
+    state.inactivity_scores = scores
+    timings["inactivity_ms"] = (time.perf_counter() - t0) * 1e3
+
+    t0 = time.perf_counter()
+    rewards = np.zeros(n, dtype=np.uint64)
+    penalties = np.zeros(n, dtype=np.uint64)
+    active_increments = (ctx.total_active_balance
+                         // preset.EFFECTIVE_BALANCE_INCREMENT)
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = ctx.unslashed_prev[flag_index]
+        unslashed_increments = (
+            _participating_balance_from(ctx.eff, participating, preset)
+            // preset.EFFECTIVE_BALANCE_INCREMENT)
+        if not in_leak:
+            reward_num = (ctx.base * np.uint64(weight)
+                          * np.uint64(unslashed_increments))
+            rewards += np.where(
+                ctx.eligible & participating,
+                reward_num
+                // np.uint64(active_increments * WEIGHT_DENOMINATOR),
+                np.uint64(0))
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties += np.where(
+                ctx.eligible & ~participating,
+                ctx.base * np.uint64(weight) // np.uint64(WEIGHT_DENOMINATOR),
+                np.uint64(0))
+    quotient = (spec.inactivity_score_bias
+                * inactivity_penalty_quotient(fork, preset))
+    inact = ctx.eff * scores // np.uint64(quotient)
+    penalties += np.where(ctx.eligible & ~target_prev, inact, np.uint64(0))
+
+    summary.rewards, summary.penalties = rewards, penalties
+    bal = _full_column(state.balances, n, np.uint64)
+    bal = bal + rewards
+    bal = np.where(bal >= penalties, bal - penalties, np.uint64(0))
+    state.balances = bal
+    timings["rewards_ms"] = (time.perf_counter() - t0) * 1e3
+
+
+def process_epoch_single_pass(state, fork: ForkName, preset, spec,
+                              T) -> EpochSummary:
+    """Altair+ epoch transition as a single columnar sweep: one
+    :class:`EpochContext` build feeds justification, inactivity, and
+    rewards; the remaining steps are already one-column passes.  Stage
+    timings land in :data:`LAST_EPOCH_TIMINGS`."""
+    import time
+    summary = EpochSummary()
+    timings: dict = {}
+    t_all = time.perf_counter()
+
+    t0 = time.perf_counter()
+    ctx = build_epoch_context(state, preset)
+    timings["context_ms"] = (time.perf_counter() - t0) * 1e3
+
+    t0 = time.perf_counter()
+    if ctx.cur > GENESIS_EPOCH + 1:
+        prev_target = _participating_balance_from(
+            ctx.eff, ctx.unslashed_prev[TIMELY_TARGET_FLAG_INDEX], preset)
+        cur_target = _participating_balance_from(ctx.eff, ctx.target_cur,
+                                                 preset)
+        summary.total_active_balance = ctx.total_active_balance
+        summary.previous_target_balance = prev_target
+        summary.current_target_balance = cur_target
+        weigh_justification_and_finalization(
+            state, ctx.total_active_balance, prev_target, cur_target,
+            preset, T)
+    timings["justification_ms"] = (time.perf_counter() - t0) * 1e3
+
+    _fused_inactivity_and_rewards(state, fork, preset, spec, ctx, summary,
+                                  timings)
+
+    t0 = time.perf_counter()
+    process_registry_updates(state, preset, spec, summary)
+    timings["registry_ms"] = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    process_slashings(state, fork, preset)
+    timings["slashings_ms"] = (time.perf_counter() - t0) * 1e3
+    process_eth1_data_reset(state, preset)
+    t0 = time.perf_counter()
+    process_effective_balance_updates(state, preset)
+    timings["effective_balance_ms"] = (time.perf_counter() - t0) * 1e3
+    process_slashings_reset(state, preset)
+    process_randao_mixes_reset(state, preset)
+    process_historical_update(state, fork, preset, T)
+    process_participation_flag_updates(state)
+    t0 = time.perf_counter()
+    process_sync_committee_updates(state, preset, T)
+    timings["shuffle_ms"] = (time.perf_counter() - t0) * 1e3
+
+    timings["total_ms"] = (time.perf_counter() - t_all) * 1e3
+    LAST_EPOCH_TIMINGS.clear()
+    LAST_EPOCH_TIMINGS.update(timings)
+    return summary
 
 
 # ---------------------------------------------------------------------------
@@ -448,11 +649,11 @@ def process_epoch_phase0(state, preset, spec, T) -> EpochSummary:
     return summary
 
 
-def process_epoch(state, fork: ForkName, preset, spec, T) -> EpochSummary:
-    """Altair+ epoch transition, step order per
-    ``per_epoch_processing/altair.rs:process_epoch``."""
-    if fork == ForkName.PHASE0:
-        return process_epoch_phase0(state, preset, spec, T)
+def process_epoch_stepwise(state, fork: ForkName, preset, spec,
+                           T) -> EpochSummary:
+    """Altair+ epoch transition, one step at a time, step order per
+    ``per_epoch_processing/altair.rs:process_epoch`` — the differential
+    oracle for :func:`process_epoch_single_pass`."""
     summary = EpochSummary()
     process_justification_and_finalization(state, preset, T, summary)
     process_inactivity_updates(state, preset, spec)
@@ -467,3 +668,13 @@ def process_epoch(state, fork: ForkName, preset, spec, T) -> EpochSummary:
     process_participation_flag_updates(state)
     process_sync_committee_updates(state, preset, T)
     return summary
+
+
+def process_epoch(state, fork: ForkName, preset, spec, T) -> EpochSummary:
+    """Altair+ epoch transition: the fused single-pass sweep by default,
+    the stepwise oracle under ``LIGHTHOUSE_TPU_SINGLE_PASS_EPOCH=0``."""
+    if fork == ForkName.PHASE0:
+        return process_epoch_phase0(state, preset, spec, T)
+    if not _single_pass_enabled():
+        return process_epoch_stepwise(state, fork, preset, spec, T)
+    return process_epoch_single_pass(state, fork, preset, spec, T)

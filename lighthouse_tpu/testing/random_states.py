@@ -1,0 +1,94 @@
+"""Randomized beacon-state builder for differential testing.
+
+Produces structurally valid altair+ states with adversarial corners the
+EF-style harness chains never reach — zero balances, slashed validators,
+huge inactivity scores, exit/withdrawable epochs in every phase — so the
+vectorized state-transition paths can be diffed bit-for-bit against the
+scalar oracle over a hostile input distribution
+(``scripts/validate_transition.py`` and ``tests/test_vectorized_transition``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FAR_FUTURE = 2 ** 64 - 1
+
+
+def random_epoch_state(rng: np.random.Generator, n: int, T, preset, fork):
+    """A random state parked on the last slot of a random epoch (the
+    process_epoch entry shape)."""
+    from ..types.validators import ValidatorRegistry
+
+    state = T.state_cls(fork)()
+    reg = ValidatorRegistry(n)
+    reg._n = n
+    exit_epoch = np.full(n, FAR_FUTURE, dtype=np.uint64)
+    exiting = rng.random(n) < 0.1
+    exit_epoch[exiting] = rng.integers(4, 16, int(exiting.sum()))
+    wd_epoch = np.full(n, FAR_FUTURE, dtype=np.uint64)
+    wd = rng.random(n) < 0.2
+    wd_epoch[wd] = rng.integers(4, 24, int(wd.sum()))
+    reg.init_columns(
+        pubkey=rng.integers(0, 256, (n, 48), dtype=np.uint8),
+        withdrawal_credentials=rng.integers(0, 256, (n, 32), dtype=np.uint8),
+        effective_balance=(rng.integers(0, 33, n) * 10 ** 9).astype(
+            np.uint64),
+        slashed=rng.random(n) < 0.05,
+        activation_epoch=rng.integers(0, 12, n).astype(np.uint64),
+        exit_epoch=exit_epoch,
+        withdrawable_epoch=wd_epoch)
+    state.validators = reg
+    state.balances = rng.integers(0, 40 * 10 ** 9, n).astype(np.uint64)
+    state.previous_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    state.current_epoch_participation = rng.integers(0, 8, n).astype(np.uint8)
+    scores = rng.integers(0, 200, n).astype(np.uint64)
+    scores[rng.random(n) < 0.02] = np.uint64(2 ** 63)  # adversarial tails
+    state.inactivity_scores = scores
+    # Avoid sync-committee-update boundaries: the random pubkeys are not
+    # valid G1 points, and (epoch+1) % EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0
+    # would make process_epoch aggregate them.
+    period = preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    epoch = int(rng.integers(2, 10))
+    while (epoch + 1) % period == 0:
+        epoch += 1
+    state.slot = epoch * preset.SLOTS_PER_EPOCH + preset.SLOTS_PER_EPOCH - 1
+    state.finalized_checkpoint = T.Checkpoint(
+        epoch=max(epoch - int(rng.integers(1, 6)), 0), root=b"\x01" * 32)
+    state.previous_justified_checkpoint = T.Checkpoint(
+        epoch=max(epoch - 2, 0), root=b"\x01" * 32)
+    state.current_justified_checkpoint = T.Checkpoint(
+        epoch=epoch - 1, root=b"\x02" * 32)
+    bits = state.justification_bits
+    bits[:] = rng.random(4) < 0.5
+    return state
+
+
+def diff_states(tag: str, got, want) -> list:
+    """Human-readable list of every mismatching column/field (empty when
+    the post-states are bit-identical)."""
+    reg_columns = ("pubkey", "withdrawal_credentials", "effective_balance",
+                   "slashed", "activation_eligibility_epoch",
+                   "activation_epoch", "exit_epoch", "withdrawable_epoch")
+    out = []
+    for col in reg_columns:
+        g, w = got.validators.col(col), want.validators.col(col)
+        if g.shape != w.shape:
+            out.append(f"validators.{col}: {g.shape} vs {w.shape}")
+        elif not np.array_equal(g, w):
+            bad = np.flatnonzero(~np.all(np.atleast_2d(g == w), axis=-1))
+            out.append(f"validators.{col}: mismatch at {bad[:8]}")
+    for field in ("balances", "inactivity_scores",
+                  "previous_epoch_participation",
+                  "current_epoch_participation"):
+        g = np.asarray(getattr(got, field))
+        w = np.asarray(getattr(want, field))
+        if g.shape != w.shape:
+            out.append(f"{field}: {g.shape} vs {w.shape}")
+        elif not np.array_equal(g, w):
+            out.append(f"{field}: mismatch at {np.flatnonzero(g != w)[:8]}")
+    if type(got).serialize(got) != type(want).serialize(want):
+        out.append(f"serialized state differs (root "
+                   f"{got.tree_hash_root().hex()[:16]} vs "
+                   f"{want.tree_hash_root().hex()[:16]})")
+    return [f"[{tag}] {line}" for line in out]
