@@ -124,6 +124,38 @@ def cmd_skip_slots(args) -> int:
     return 0
 
 
+def _load_identity(datadir: str) -> bytes:
+    """Load (or mint + persist) the node's static X25519 identity key —
+    the reference persists its libp2p keypair at ``<datadir>/beacon/
+    network/key`` so the node id survives restarts; same deal here.
+    Without a datadir the identity is ephemeral."""
+    import os
+    import secrets as pysecrets
+
+    path = os.path.join(datadir, "node_key")
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                key = bytes.fromhex(f.read().strip())
+            if len(key) == 32:
+                return key
+        except ValueError:
+            pass
+        # Truncated/corrupt key file (e.g. a crash mid-write before the
+        # atomic-rename scheme below existed): the identity is already
+        # lost — mint a new one instead of bricking startup forever.
+        print(f"warning: corrupt identity key at {path}; regenerating "
+              f"(node id will change)")
+    key = pysecrets.token_bytes(32)
+    os.makedirs(datadir, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(key.hex())
+    os.chmod(tmp, 0o600)
+    os.replace(tmp, path)  # atomic: never a half-written identity
+    return key
+
+
 def cmd_beacon_node(args) -> int:
     """Run an interop beacon node + HTTP API (demo/devnet mode)."""
     from .api import HttpApiServer
@@ -159,6 +191,27 @@ def cmd_beacon_node(args) -> int:
     if args.validator_monitor_auto:
         from .beacon_chain.validator_monitor import ValidatorMonitor
         chain.validator_monitor = ValidatorMonitor(auto_register=True)
+    # Wire networking: encrypted by default (`--insecure` keeps the
+    # plaintext framing for debugging).  The identity key persists in
+    # the datadir so scores/bans keyed on the node id survive restarts.
+    net = None
+    disco = None
+    if args.listen_port is not None or args.boot_node:
+        from .network.transport import WireNetwork
+
+        static_key = _load_identity(args.datadir) if args.datadir else None
+        net = WireNetwork(chain, name="bn",
+                          port=args.listen_port or 0,
+                          secure=not args.insecure,
+                          static_key=static_key)
+        mode = "plaintext (INSECURE)" if args.insecure else "noise-xx"
+        print(f"wire transport up: tcp://127.0.0.1:{net.port} "
+              f"[{mode}] node_id={net.node_id.hex()}")
+        if args.boot_node:
+            host, _, port_s = args.boot_node.rpartition(":")
+            disco = net.discover(host or "127.0.0.1", int(port_s))
+            print(f"discovery up: udp://127.0.0.1:{disco.udp_port} "
+                  f"boot={args.boot_node}")
     api = HttpApiServer(chain, port=args.http_port)
     api.start()
     print(f"beacon node up: http://127.0.0.1:{api.port} "
@@ -246,6 +299,10 @@ def cmd_beacon_node(args) -> int:
             print(f"warning: tasks did not stop: {stragglers}")
         if args.datadir:
             chain.persist()  # graceful-shutdown persistence
+        if disco is not None:
+            disco.close()
+        if net is not None:
+            net.close()
     if km is not None:
         km.stop()
     api.stop()
@@ -365,6 +422,16 @@ def main(argv=None) -> int:
     bn.add_argument("--datadir", default="")
     bn.add_argument("--run-for", type=float, default=0,
                     help="seconds to run (0 = forever)")
+    bn.add_argument("--listen-port", type=int, default=None,
+                    help="TCP wire-transport listen port (0 = ephemeral; "
+                         "omit to run without wire networking)")
+    bn.add_argument("--boot-node", default="",
+                    help="bootstrap UDP endpoint host:port (a boot-node "
+                         "process or any node's discovery port)")
+    bn.add_argument("--insecure", action="store_true",
+                    help="disable the noise-xx encrypted transport and "
+                         "speak legacy plaintext frames (debugging / "
+                         "simulator escape hatch)")
     bn.set_defaults(fn=cmd_beacon_node)
 
     tb = sub.add_parser("transition-blocks",

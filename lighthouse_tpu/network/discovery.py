@@ -2,17 +2,27 @@
 (``/root/reference/beacon_node/lighthouse_network/src/discovery/`` and the
 standalone ``boot_node`` subcommand, ``boot_node/src/``).
 
-Real discv5 is a Kademlia DHT over authenticated UDP; this environment's
-stand-in keeps the deployment shape (a UDP boot node that never joins the
-chain + per-node discovery services that register and query it) with an
-ENR-lite record: ``node_id (8B) | tcp_port (u16) | head_slot (u64)``.
+Real discv5 is a Kademlia DHT over authenticated UDP; this module keeps
+the deployment shape (a boot node that never joins the chain + per-node
+discovery services) but the per-node service is now a real Kademlia
+participant (:class:`KademliaDiscovery`): every node answers FINDNODE
+from its own k-bucket table (:mod:`.secure.kademlia`), lookups are
+iterative (query the α closest, absorb, repeat until no closer contact
+remains), buckets refresh on staleness, and full buckets evict via
+liveness ping — so a node bootstraps through a peer-of-a-peer it never
+had in its config, instead of depending on one flat registry.
 
-Frames (all little-endian):
+ENR-lite record: ``node_id (8B) | ipv4 | udp_port | tcp_port``; the
+node id is ``sha256(static_x25519_pub)[:8]``, and the TCP dial pins it —
+a record advertising someone else's id fails the Noise handshake.
 
-    0 PING  node_id(8) tcp_port(2)      → registers the sender
-    1 PONG
-    2 FIND                              → asks for known records
-    3 NODES count(u16) records(18B each: node_id, tcp_port, ipv4)
+Frames (all little-endian; one datagram each):
+
+    0 PING      node_id(8) tcp_port(2)            → registers the sender
+    1 PONG      node_id(8) tcp_port(2)            (1-byte legacy accepted)
+    4 FINDNODE  token(4) node_id(8) tcp_port(2) target(8)
+    5 NODES     token(4) count(u8) records(16B each:
+                node_id(8) ipv4(4) udp(2) tcp(2))
 """
 
 from __future__ import annotations
@@ -23,30 +33,80 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..common import metrics
 from ..common.logging import Logger, test_logger
+from .secure.kademlia import (
+    BUCKET_SIZE,
+    Contact,
+    KBucketTable,
+    LookupState,
+    REFRESH_INTERVAL_S,
+    xor_distance,
+)
 
 MSG_PING = 0
 MSG_PONG = 1
-MSG_FIND = 2
-MSG_NODES = 3
+MSG_FINDNODE = 4
+MSG_NODES = 5
 
-RECORD = struct.Struct("<8sH4s")  # node_id, tcp_port, ipv4
+RECORD = struct.Struct("<8s4sHH")  # node_id, ipv4, udp_port, tcp_port
+
+
+def _pack_nodes(token: bytes, contacts: List[Contact]) -> bytes:
+    out = [bytes([MSG_NODES]), token, bytes([len(contacts)])]
+    for c in contacts:
+        out.append(RECORD.pack(c.node_id, socket.inet_aton(c.host),
+                               c.udp_port, c.tcp_port))
+    return b"".join(out)
+
+
+def _unpack_nodes(data: bytes) -> List[Contact]:
+    count = data[5]
+    contacts = []
+    off = 6
+    for _ in range(count):
+        nid, ip, udp, tcp = RECORD.unpack_from(data, off)
+        off += RECORD.size
+        contacts.append(Contact(nid, socket.inet_ntoa(ip), udp, tcp))
+    return contacts
 
 
 class BootNode:
-    """Standalone registry process (`boot_node/src/server.rs` role): keeps
-    liveness-pruned records, answers FIND with everyone it knows."""
+    """Standalone bootstrap process (`boot_node/src/server.rs` role): a
+    Kademlia responder with a liveness-pruned record store that never
+    TCP-dials anyone (its records advertise ``tcp_port=0``)."""
 
     LIVENESS_S = 60.0
 
     def __init__(self, port: int = 0, log: Optional[Logger] = None):
+        import secrets as _secrets
+
         self.log = (log or test_logger()).child("boot_node")
+        self.node_id = _secrets.token_bytes(8)
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind(("127.0.0.1", port))
         self.port = self.sock.getsockname()[1]
-        self.records: Dict[bytes, Tuple[int, bytes, float]] = {}
+        # node_id → Contact (+ last-seen inside the contact)
+        self.records: Dict[bytes, Contact] = {}
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+
+    def _register(self, data: bytes, addr, off: int) -> None:
+        node_id = data[off:off + 8]
+        (tcp_port,) = struct.unpack_from("<H", data, off + 8)
+        fresh = node_id not in self.records
+        self.records[node_id] = Contact(node_id, addr[0], addr[1],
+                                        tcp_port)
+        if fresh:
+            self.log.info("peer registered", node=node_id.hex(),
+                          port=tcp_port)
+
+    def _prune(self) -> None:
+        # Each node restart mints a fresh node id, so a long-lived boot
+        # node would otherwise accumulate a record per restart forever.
+        now = time.monotonic()
+        self.records = {nid: c for nid, c in self.records.items()
+                        if now - c.last_seen < self.LIVENESS_S}
 
     def _serve(self) -> None:
         while True:
@@ -58,102 +118,256 @@ class BootNode:
                 continue
             kind = data[0]
             if kind == MSG_PING and len(data) >= 11:
-                node_id = data[1:9]
-                (tcp_port,) = struct.unpack_from("<H", data, 9)
-                ip = socket.inet_aton(addr[0])
-                fresh = node_id not in self.records
-                self.records[node_id] = (tcp_port, ip, time.monotonic())
-                if fresh:
-                    self.log.info("peer registered",
-                                  node=node_id.hex(), port=tcp_port)
-                self.sock.sendto(bytes([MSG_PONG]), addr)
-            elif kind == MSG_FIND:
-                now = time.monotonic()
-                # Prune dead records in place — each node restart mints a
-                # fresh node_id, so a long-lived boot node would otherwise
-                # accumulate a record per restart forever.
-                self.records = {
-                    nid: rec for nid, rec in self.records.items()
-                    if now - rec[2] < self.LIVENESS_S}
-                live = [(nid, p, ip) for nid, (p, ip, seen)
-                        in self.records.items()]
-                out = [bytes([MSG_NODES]), struct.pack("<H", len(live))]
-                for nid, p, ip in live:
-                    out.append(RECORD.pack(nid, p, ip))
-                self.sock.sendto(b"".join(out), addr)
+                self._register(data, addr, 1)
+                self.sock.sendto(
+                    bytes([MSG_PONG]) + self.node_id
+                    + struct.pack("<H", 0), addr)
+            elif kind == MSG_FINDNODE and len(data) >= 23:
+                token = data[1:5]
+                self._register(data, addr, 5)
+                target = data[15:23]
+                self._prune()
+                close = sorted(
+                    self.records.values(),
+                    key=lambda c: xor_distance(c.node_id, target))
+                self.sock.sendto(_pack_nodes(token, close[:BUCKET_SIZE]),
+                                 addr)
 
     def close(self) -> None:
         self.sock.close()
 
 
-class DiscoveryService:
-    """Per-node client (`discovery/mod.rs` role): registers this node's
-    wire endpoint with the boot node and dials newly discovered peers."""
+class KademliaDiscovery:
+    """Per-node discovery service: one UDP socket that both ANSWERS the
+    DHT protocol (PING → PONG + table insert, FINDNODE → k closest) and
+    DRIVES it (periodic self-lookup + stale-bucket refresh through
+    :class:`~.secure.kademlia.LookupState`).  Fresh dialable records are
+    handed to ``dial(host, tcp_port, expected_id=node_id)``."""
+
+    FIND_TIMEOUT_S = 1.5
+    PING_TIMEOUT_S = 1.0
 
     def __init__(self, node_id: bytes, tcp_port: int,
-                 boot_addr: Tuple[str, int],
-                 dial: Callable[[str, int], object],
-                 interval: float = 2.0, log: Optional[Logger] = None):
-        self.node_id = node_id
+                 bootstrap: List[Tuple[str, int]],
+                 dial: Callable[..., object],
+                 interval: float = 2.0, log: Optional[Logger] = None,
+                 refresh_interval: float = REFRESH_INTERVAL_S,
+                 port: int = 0):
+        self.node_id = bytes(node_id)
         self.tcp_port = tcp_port
-        self.boot_addr = boot_addr
-        self.dial = dial  # (host, port) → peer handle; dedup is dial's job
+        self.bootstrap = list(bootstrap)
+        self.dial = dial
         self.interval = interval
+        self.refresh_interval = refresh_interval
         self.log = (log or test_logger()).child("discovery")
-        self.known: set[bytes] = {node_id}
+        self.table = KBucketTable(self.node_id)
+        self.known: set[bytes] = {self.node_id}  # node ids ever dialed
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self.sock.settimeout(3.0)
+        self.sock.bind(("127.0.0.1", port))
+        self.udp_port = self.sock.getsockname()[1]
+        self._lock = threading.Lock()
+        self._token = 0
+        # token → [event, contacts-or-None]; addr → list of ping events
+        self._pending: Dict[bytes, list] = {}
+        self._ping_waiters: Dict[Tuple[str, int], List[threading.Event]]\
+            = {}
         self._stop = threading.Event()
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True)
+        self._rx.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def _recv_kind(self, kind: int) -> bytes | None:
-        """Receive until a frame of ``kind`` arrives or the socket times
-        out.  A PONG delayed past one round's timeout otherwise desyncs
-        every later round (the stale PONG answers the next FIND, and the
-        64-byte PONG read would truncate-and-drop a NODES datagram) —
-        the cause of the discovery-mesh flake under full-suite load."""
-        deadline = time.monotonic() + self.sock.gettimeout()
-        while time.monotonic() < deadline:
-            try:
-                data, _ = self.sock.recvfrom(65536)
-            except OSError:
-                return None
-            if data and data[0] == kind:
-                return data
-        return None
+    # -- the server side ------------------------------------------------------
 
-    def poll_once(self) -> List[Tuple[bytes, int, str]]:
-        """One PING + FIND round; dials fresh records. Returns them."""
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                data, addr = self.sock.recvfrom(65536)
+            except OSError:
+                return
+            try:
+                self._dispatch(data, addr)
+            except Exception:
+                pass  # malformed datagrams never kill the service
+
+    def _dispatch(self, data: bytes, addr: Tuple[str, int]) -> None:
+        if not data:
+            return
+        kind = data[0]
+        if kind == MSG_PING and len(data) >= 11:
+            nid = data[1:9]
+            (tcp,) = struct.unpack_from("<H", data, 9)
+            self._consider(Contact(nid, addr[0], addr[1], tcp))
+            self.sock.sendto(
+                bytes([MSG_PONG]) + self.node_id
+                + struct.pack("<H", self.tcp_port), addr)
+        elif kind == MSG_PONG:
+            if len(data) >= 11:  # extended PONG carries the responder
+                nid = data[1:9]
+                (tcp,) = struct.unpack_from("<H", data, 9)
+                self._consider(Contact(nid, addr[0], addr[1], tcp))
+            with self._lock:
+                events = self._ping_waiters.pop(addr, [])
+            for ev in events:
+                ev.set()
+        elif kind == MSG_FINDNODE and len(data) >= 23:
+            token = data[1:5]
+            nid = data[5:13]
+            (tcp,) = struct.unpack_from("<H", data, 13)
+            target = data[15:23]
+            self._consider(Contact(nid, addr[0], addr[1], tcp))
+            close = [c for c in self.table.closest(target, BUCKET_SIZE)
+                     if c.node_id != nid]
+            self.sock.sendto(_pack_nodes(token, close), addr)
+        elif kind == MSG_NODES and len(data) >= 6:
+            token = data[1:5]
+            with self._lock:
+                entry = self._pending.get(token)
+            if entry is None:
+                return  # late response to a timed-out query
+            entry[1] = _unpack_nodes(data)
+            entry[0].set()
+
+    # -- the client side ------------------------------------------------------
+
+    def _ping(self, addr: Tuple[str, int],
+              timeout: Optional[float] = None) -> bool:
+        ev = threading.Event()
+        with self._lock:
+            self._ping_waiters.setdefault(addr, []).append(ev)
         try:
             self.sock.sendto(
                 bytes([MSG_PING]) + self.node_id
-                + struct.pack("<H", self.tcp_port), self.boot_addr)
-            if self._recv_kind(MSG_PONG) is None:
+                + struct.pack("<H", self.tcp_port), addr)
+            return ev.wait(timeout or self.PING_TIMEOUT_S)
+        except OSError:
+            return False
+        finally:
+            with self._lock:
+                waiters = self._ping_waiters.get(addr)
+                if waiters and ev in waiters:
+                    waiters.remove(ev)
+                    if not waiters:
+                        self._ping_waiters.pop(addr, None)
+
+    def find_node(self, addr: Tuple[str, int], target: bytes,
+                  timeout: Optional[float] = None) -> List[Contact]:
+        """One FINDNODE round-trip to ``addr``; [] on timeout."""
+        with self._lock:
+            self._token = (self._token + 1) & 0xFFFFFFFF
+            token = struct.pack("<I", self._token)
+            entry = [threading.Event(), None]
+            self._pending[token] = entry
+        try:
+            self.sock.sendto(
+                bytes([MSG_FINDNODE]) + token + self.node_id
+                + struct.pack("<H", self.tcp_port) + bytes(target), addr)
+            if not entry[0].wait(timeout or self.FIND_TIMEOUT_S):
                 return []
-            self.sock.sendto(bytes([MSG_FIND]), self.boot_addr)
-            data = self._recv_kind(MSG_NODES)
+            return entry[1] or []
         except OSError:
             return []
-        if not data:
-            return []
-        (n,) = struct.unpack_from("<H", data, 1)
-        fresh = []
-        off = 3
-        for _ in range(n):
-            nid, port, ip = RECORD.unpack_from(data, off)
-            off += RECORD.size
-            if nid in self.known:
-                continue
-            self.known.add(nid)
-            host = socket.inet_ntoa(ip)
-            fresh.append((nid, port, host))
-            try:
-                self.dial(host, port)
-                self.log.info("discovered peer", node=nid.hex(), port=port)
-            except OSError:
-                self.known.discard(nid)  # retry on the next round
-        return fresh
+        finally:
+            with self._lock:
+                self._pending.pop(token, None)
+
+    def lookup(self, target: bytes) -> List[Contact]:
+        """Iterative Kademlia node lookup: seed from our table (and the
+        bootstrap endpoints when the table is empty), query the α
+        closest unvisited contacts, absorb, repeat until converged.
+        Every contact learned along the way feeds the table + dialer."""
+        t0 = time.perf_counter()
+        self.table.mark_lookup(target)
+        state = LookupState(target, self.table.closest(target,
+                                                       BUCKET_SIZE))
+        for addr in self.bootstrap:
+            # Bootstrap endpoints are addr-only (no id yet): query them
+            # directly in round 0 — cheap, and it registers us there.
+            for c in self.find_node(addr, target):
+                self._consider(c)
+                state.absorb([c])
+        while True:
+            batch = state.next_batch()
+            if not batch:
+                break
+            # The α queries really do fly concurrently — a batch of dead
+            # contacts costs ONE find timeout, not α of them stacked.
+            results: List[List[Contact]] = [[] for _ in batch]
+
+            def _query(i, c):
+                results[i] = self.find_node(c.udp_addr, target)
+
+            threads = [threading.Thread(target=_query, args=(i, c),
+                                        daemon=True)
+                       for i, c in enumerate(batch)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(self.FIND_TIMEOUT_S + 1.0)
+            for found_list in results:
+                for found in found_list:
+                    self._consider(found)
+                    state.absorb([found])
+            if state.done():
+                break
+        metrics.observe("network_discovery_lookup_seconds",
+                        time.perf_counter() - t0)
+        return state.result()
+
+    # -- table/dial plumbing --------------------------------------------------
+
+    def _consider(self, contact: Contact) -> None:
+        """A live record reached us: fold it into the k-bucket table
+        (with the Kademlia liveness-eviction rule on full buckets) and
+        dial it if it is fresh and dialable."""
+        if contact.node_id == self.node_id:
+            return
+        candidate = self.table.update(contact)
+        if candidate is not None:
+            # Full bucket: ping the LRU member off-thread; only a dead
+            # one is evicted for the newcomer (liveness bias).
+            threading.Thread(
+                target=self._evict_or_keep, args=(candidate, contact),
+                daemon=True).start()
+        if contact.tcp_port:
+            with self._lock:  # one dial per node id, ever (until failed)
+                if contact.node_id in self.known:
+                    return
+                self.known.add(contact.node_id)
+            threading.Thread(
+                target=self._dial, args=(contact,), daemon=True).start()
+
+    def _evict_or_keep(self, candidate: Contact, newcomer: Contact
+                       ) -> None:
+        if self._ping(candidate.udp_addr):
+            return  # old node is alive: the newcomer is dropped
+        self.table.evict(candidate.node_id)
+        self.table.update(newcomer)
+        self.log.info("evicted dead contact",
+                      node=candidate.node_id.hex())
+
+    def _dial(self, contact: Contact) -> None:
+        try:
+            self.dial(contact.host, contact.tcp_port,
+                      expected_id=contact.node_id)
+            self.log.info("discovered peer", node=contact.node_id.hex(),
+                          port=contact.tcp_port)
+        except OSError:
+            with self._lock:
+                self.known.discard(contact.node_id)  # retry next round
+
+    # -- the drive loop -------------------------------------------------------
+
+    def poll_once(self) -> List[Contact]:
+        """One discovery round: announce to the bootstrap endpoints,
+        self-lookup (who is near us?), then refresh stale buckets with
+        random-target lookups."""
+        for addr in self.bootstrap:
+            self._ping(addr)
+        found = self.lookup(self.node_id)
+        for i in self.table.stale_buckets(self.refresh_interval):
+            self.lookup(self.table.random_id_in_bucket(i))
+        return found
 
     def _loop(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,46 @@
+"""Secure p2p subsystem: Noise-role encrypted transport, pluggable
+compression, and Kademlia routing (VERDICT r5 item 8).
+
+- :mod:`.x25519` / :mod:`.chacha` — RFC 7748 / RFC 8439 primitives,
+  dependency-free (vector-pinned in ``tests/test_secure_channel.py``).
+- :mod:`.noise` — the Noise-XX handshake + AEAD record layer the wire
+  transport (:mod:`..transport`) runs every TCP connection through.
+- :mod:`.codec` — the negotiated per-frame compression seam (identity
+  now, snappy auto-detected when importable).
+- :mod:`.kademlia` — the k-bucket table + iterative-lookup state driving
+  :class:`..discovery.KademliaDiscovery`.
+"""
+
+from .chacha import AuthError
+from .codec import CODEC_IDENTITY, CODEC_SNAPPY, Codec
+from .kademlia import (
+    BUCKET_SIZE,
+    Contact,
+    KBucketTable,
+    LookupState,
+    xor_distance,
+)
+from .noise import (
+    HandshakeError,
+    SecureChannel,
+    initiate,
+    node_id_of,
+    respond,
+)
+
+__all__ = [
+    "AuthError",
+    "BUCKET_SIZE",
+    "CODEC_IDENTITY",
+    "CODEC_SNAPPY",
+    "Codec",
+    "Contact",
+    "HandshakeError",
+    "KBucketTable",
+    "LookupState",
+    "SecureChannel",
+    "initiate",
+    "node_id_of",
+    "respond",
+    "xor_distance",
+]

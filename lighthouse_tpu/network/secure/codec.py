@@ -1,0 +1,115 @@
+"""Pluggable frame compression — the SSZ-snappy seam.
+
+The reference's Req/Resp streams are SSZ-snappy (``rpc/codec/``); this
+environment has no snappy library, so the seam ships with the identity
+codec and auto-detects ``snappy``/``cramjam`` when importable.  The codec
+is NEGOTIATED in the secure handshake (initiator offers a bitmask in the
+prologue, responder answers its pick inside the first encrypted payload)
+and applied per-frame UNDER the AEAD layer: compress → encrypt, so the
+wire shows only ciphertext.
+
+Every frame updates the process-global byte counters in
+:mod:`~lighthouse_tpu.common.metrics` (``network_codec_raw_bytes_total``
+vs ``network_codec_wire_bytes_total``), so the compression win — or the
+identity codec's absence of one — stays measured, not assumed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ...common import metrics
+
+CODEC_IDENTITY = 0
+CODEC_SNAPPY = 1
+
+# Per-frame flag byte prepended to the plaintext: did THIS frame actually
+# get compressed?  (A codec may decline — e.g. incompressible or tiny
+# frames — without renegotiating.)
+FLAG_RAW = 0
+FLAG_COMPRESSED = 1
+
+
+def _load_snappy():
+    try:  # python-snappy
+        import snappy  # type: ignore
+
+        return snappy.compress, snappy.decompress
+    except Exception:
+        pass
+    try:  # cramjam ships a snappy module too
+        import cramjam  # type: ignore
+
+        return (lambda b: bytes(cramjam.snappy.compress_raw(b)),
+                lambda b: bytes(cramjam.snappy.decompress_raw(b)))
+    except Exception:
+        return None
+
+
+_SNAPPY = _load_snappy()
+
+# Frames below this never attempt compression (header + tiny SSZ bodies
+# don't win back the codec flag byte, let alone the CPU).
+MIN_COMPRESS_LEN = 64
+
+
+class Codec:
+    """One negotiated codec instance; wraps/unwraps a plaintext frame."""
+
+    def __init__(self, codec_id: int):
+        if codec_id == CODEC_SNAPPY and _SNAPPY is None:
+            raise ValueError("snappy negotiated but not importable")
+        self.codec_id = codec_id
+        self._raw = metrics.counter(
+            "network_codec_raw_bytes_total",
+            "plaintext frame bytes before compression")
+        self._wire = metrics.counter(
+            "network_codec_wire_bytes_total",
+            "frame bytes after the codec (pre-AEAD)")
+        self._frames = metrics.counter(
+            "network_codec_frames_total", "frames through the codec seam")
+
+    def encode(self, frame: bytes) -> bytes:
+        """frame → flag byte + (possibly compressed) body."""
+        out = bytes([FLAG_RAW]) + frame
+        if (self.codec_id == CODEC_SNAPPY
+                and len(frame) >= MIN_COMPRESS_LEN):
+            packed = _SNAPPY[0](frame)
+            if len(packed) < len(frame):
+                out = bytes([FLAG_COMPRESSED]) + packed
+        self._frames.inc()
+        self._raw.inc(len(frame))
+        self._wire.inc(len(out) - 1)
+        return out
+
+    def decode(self, data: bytes) -> bytes:
+        if not data:
+            raise ValueError("empty codec frame")
+        flag, body = data[0], data[1:]
+        if flag == FLAG_RAW:
+            return body
+        if flag == FLAG_COMPRESSED:
+            if self.codec_id != CODEC_SNAPPY:
+                raise ValueError("compressed frame on identity codec")
+            return _SNAPPY[1](body)
+        raise ValueError(f"unknown codec flag {flag}")
+
+
+def supported_mask() -> int:
+    """Bitmask of codecs THIS process can run (the handshake offer)."""
+    mask = 1 << CODEC_IDENTITY
+    if _SNAPPY is not None:
+        mask |= 1 << CODEC_SNAPPY
+    return mask
+
+
+def choose(offer_mask: int, local_mask: Optional[int] = None) -> int:
+    """Responder's pick: best codec both sides support.  An offer with no
+    overlap (a peer speaking only codecs we lack) falls back to identity
+    — every implementation MUST support it, so the connection degrades
+    instead of failing."""
+    local = supported_mask() if local_mask is None else local_mask
+    both = offer_mask & local
+    if both & (1 << CODEC_SNAPPY):
+        return CODEC_SNAPPY
+    return CODEC_IDENTITY

@@ -1,0 +1,200 @@
+"""Kademlia k-bucket routing table over 64-bit node ids.
+
+The discv5 role (``/root/reference/beacon_node/lighthouse_network/src/
+discovery/`` wraps sigp's discv5, itself a Kademlia DHT): node ids live
+in an XOR metric space; bucket ``i`` holds contacts whose distance to us
+has its highest set bit at position ``i``.  Buckets are LRU-ordered with
+the classic liveness bias: a full bucket NEVER evicts a live node for a
+fresh one — the caller pings the least-recently-seen member and only
+replaces it if that ping times out (old nodes are the reliable ones;
+this is also the Sybil resistance argument from the Kademlia paper).
+
+Pure data structure + pure lookup bookkeeping (:class:`LookupState`) —
+all sockets live in :mod:`..discovery`, so this whole module unit-tests
+without I/O.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+ID_BITS = 64
+BUCKET_SIZE = 16          # k
+LOOKUP_CONCURRENCY = 3    # alpha
+REFRESH_INTERVAL_S = 60.0  # a bucket untouched this long gets a lookup
+
+
+def xor_distance(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+@dataclass
+class Contact:
+    """ENR-lite record + liveness bookkeeping."""
+    node_id: bytes
+    host: str
+    udp_port: int
+    tcp_port: int
+    last_seen: float = field(default_factory=time.monotonic)
+
+    @property
+    def udp_addr(self) -> Tuple[str, int]:
+        return (self.host, self.udp_port)
+
+
+class KBucketTable:
+    """Thread-safe: the discovery service mutates the table from its
+    receive loop, its drive loop, AND the per-candidate liveness-ping
+    threads; every public method holds the table lock (the buckets are
+    tiny, so the critical sections are microseconds)."""
+
+    def __init__(self, self_id: bytes, k: int = BUCKET_SIZE):
+        import threading
+
+        self.self_id = bytes(self_id)
+        self.k = k
+        self.buckets: List[List[Contact]] = [[] for _ in range(ID_BITS)]
+        self.last_lookup = [0.0] * ID_BITS  # per-bucket refresh clock
+        self._lock = threading.Lock()
+
+    def _bucket_index(self, node_id: bytes) -> Optional[int]:
+        d = xor_distance(self.self_id, node_id)
+        if d == 0:
+            return None  # never track ourselves
+        return d.bit_length() - 1
+
+    def get(self, node_id: bytes) -> Optional[Contact]:
+        i = self._bucket_index(node_id)
+        if i is None:
+            return None
+        with self._lock:
+            for c in self.buckets[i]:
+                if c.node_id == node_id:
+                    return c
+        return None
+
+    def update(self, contact: Contact) -> Optional[Contact]:
+        """Insert/refresh a contact (most-recently-seen goes last).
+
+        Returns ``None`` when the contact was stored, or the bucket's
+        LEAST-recently-seen member when the bucket is full — the caller
+        should liveness-ping that candidate and either ``evict`` it (and
+        re-``update``) or drop the newcomer."""
+        i = self._bucket_index(contact.node_id)
+        if i is None:
+            return None
+        with self._lock:
+            bucket = self.buckets[i]
+            for pos, c in enumerate(bucket):
+                if c.node_id == contact.node_id:
+                    # refresh in place (endpoint may move), move to MRU
+                    bucket.pop(pos)
+                    contact.last_seen = time.monotonic()
+                    bucket.append(contact)
+                    return None
+            if len(bucket) < self.k:
+                contact.last_seen = time.monotonic()
+                bucket.append(contact)
+                return None
+            return bucket[0]  # full: LRU member is the eviction candidate
+
+    def evict(self, node_id: bytes) -> bool:
+        i = self._bucket_index(node_id)
+        if i is None:
+            return False
+        with self._lock:
+            bucket = self.buckets[i]
+            for pos, c in enumerate(bucket):
+                if c.node_id == node_id:
+                    bucket.pop(pos)
+                    return True
+        return False
+
+    def closest(self, target: bytes, n: Optional[int] = None
+                ) -> List[Contact]:
+        """All known contacts ordered by XOR distance to ``target``."""
+        with self._lock:
+            out = [c for bucket in self.buckets for c in bucket]
+        out.sort(key=lambda c: xor_distance(c.node_id, target))
+        return out[: (self.k if n is None else n)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self.buckets)
+
+    def contacts(self) -> List[Contact]:
+        with self._lock:
+            return [c for bucket in self.buckets for c in bucket]
+
+    # -- refresh bookkeeping --------------------------------------------------
+
+    def mark_lookup(self, target: bytes) -> None:
+        i = self._bucket_index(target)
+        if i is not None:
+            self.last_lookup[i] = time.monotonic()
+
+    def stale_buckets(self, max_age: float = REFRESH_INTERVAL_S
+                      ) -> List[int]:
+        """Non-empty buckets with no lookup landing in them recently —
+        each gets a random-target refresh lookup (Kademlia §2.3)."""
+        now = time.monotonic()
+        with self._lock:
+            return [i for i in range(ID_BITS)
+                    if self.buckets[i]
+                    and now - self.last_lookup[i] > max_age]
+
+    def random_id_in_bucket(self, i: int) -> bytes:
+        """A target id whose distance from us lands in bucket ``i``."""
+        import secrets
+
+        d = (1 << i) | secrets.randbits(i)
+        return (int.from_bytes(self.self_id, "big") ^ d).to_bytes(
+            ID_BITS // 8, "big")
+
+
+class LookupState:
+    """Iterative FINDNODE bookkeeping (Kademlia's node lookup): track a
+    shortlist of the closest-seen contacts, hand out the next α unqueried
+    ones, absorb responses, and report convergence (no contact closer
+    than anything already queried remains).  The I/O loop in
+    ``discovery.KademliaDiscovery.lookup`` drives it."""
+
+    def __init__(self, target: bytes, seeds: Iterable[Contact],
+                 k: int = BUCKET_SIZE, alpha: int = LOOKUP_CONCURRENCY):
+        self.target = bytes(target)
+        self.k = k
+        self.alpha = alpha
+        self.queried: set[bytes] = set()
+        self.seen: Dict[bytes, Contact] = {}
+        for c in seeds:
+            self.seen[c.node_id] = c
+
+    def _shortlist(self) -> List[Contact]:
+        out = sorted(self.seen.values(),
+                     key=lambda c: xor_distance(c.node_id, self.target))
+        return out[: self.k]
+
+    def next_batch(self) -> List[Contact]:
+        batch = [c for c in self._shortlist()
+                 if c.node_id not in self.queried][: self.alpha]
+        for c in batch:
+            self.queried.add(c.node_id)
+        return batch
+
+    def absorb(self, contacts: Iterable[Contact]) -> List[Contact]:
+        """Merge a response; returns the contacts that were new."""
+        fresh = []
+        for c in contacts:
+            if c.node_id not in self.seen:
+                self.seen[c.node_id] = c
+                fresh.append(c)
+        return fresh
+
+    def done(self) -> bool:
+        return not any(c.node_id not in self.queried
+                       for c in self._shortlist())
+
+    def result(self) -> List[Contact]:
+        return self._shortlist()

@@ -1,0 +1,332 @@
+"""Noise-XX secure channel over a TCP socket.
+
+The role of the reference's libp2p noise transport
+(``lighthouse_network``'s connection upgrade): mutual static-key
+authentication with identity hiding, bound to the node id the rest of
+the stack keys scores and bans on (``node_id = sha256(static_pub)[:8]``
+— forging a node id now requires forging an X25519 key, not editing a
+Status frame).
+
+Handshake (Noise XX message pattern over X25519/ChaChaPoly/SHA-256):
+
+    prologue:  codec offer byte (mixed into h by both sides — a MitM
+               stripping compression breaks the handshake instead)
+    → msg1:    e
+    ← msg2:    e, ee, s, es   + encrypted payload: chosen codec byte
+    → msg3:    s, se          + encrypted payload: empty
+
+Each message travels as ``u16 len | body``.  After msg3 the symmetric
+state splits into one AEAD key per direction; records are
+
+    u32 len | AEAD(k_dir, nonce=LE64(counter), codec(frame))
+
+with independent per-direction nonce counters and REKEY-ON-OVERFLOW:
+when a direction's counter reaches ``rekey_after`` the key ratchets
+(``k = HMAC(k, "rekey")``) and the counter resets — a long-lived
+connection can never reuse a (key, nonce) pair.  Handshake and
+per-record costs land in ``common.metrics`` histograms so the crypto
+overhead stays a measured quantity (cf. *Performance of EdDSA and BLS
+Signatures in Committee-Based Consensus*).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import struct
+import time
+from typing import Optional, Tuple
+
+from ...common import metrics
+from . import chacha, codec as codec_mod, x25519
+
+PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256_lighthouse-tpu"
+
+# 64-bit nonce space; rekey long before it can wrap.  Small enough to
+# exercise in tests via the constructor override.
+REKEY_AFTER_DEFAULT = 1 << 20
+
+HANDSHAKE_TIMEOUT_S = 8.0
+
+
+class HandshakeError(Exception):
+    """Handshake failed: truncated, tampered, or identity mismatch."""
+
+
+def node_id_of(static_pub: bytes) -> bytes:
+    """The stable node id the peer manager keys on."""
+    return hashlib.sha256(static_pub).digest()[:8]
+
+
+def _hkdf2(ck: bytes, ikm: bytes) -> Tuple[bytes, bytes]:
+    """Noise HKDF (RFC 5869 with the chaining key as salt), 2 outputs."""
+    prk = hmac.new(ck, ikm, hashlib.sha256).digest()
+    t1 = hmac.new(prk, b"\x01", hashlib.sha256).digest()
+    t2 = hmac.new(prk, t1 + b"\x02", hashlib.sha256).digest()
+    return t1, t2
+
+
+class _SymmetricState:
+    """Noise symmetric state: transcript hash h + chaining key ck + the
+    current handshake cipher key/nonce."""
+
+    def __init__(self):
+        self.h = hashlib.sha256(PROTOCOL_NAME).digest()
+        self.ck = self.h
+        self.k: Optional[bytes] = None
+        self.n = 0
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = hashlib.sha256(self.h + data).digest()
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, self.k = _hkdf2(self.ck, ikm)
+        self.n = 0
+
+    def _nonce(self) -> bytes:
+        n = struct.pack("<4xQ", self.n)
+        self.n += 1
+        return n
+
+    def encrypt_and_hash(self, pt: bytes) -> bytes:
+        if self.k is None:
+            self.mix_hash(pt)
+            return pt
+        ct = chacha.seal(self.k, self._nonce(), pt, aad=self.h)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ct: bytes) -> bytes:
+        if self.k is None:
+            self.mix_hash(ct)
+            return ct
+        try:
+            pt = chacha.open_(self.k, self._nonce(), ct, aad=self.h)
+        except chacha.AuthError as e:
+            raise HandshakeError(f"handshake AEAD failed: {e}") from e
+        self.mix_hash(ct)
+        return pt
+
+    def split(self) -> Tuple[bytes, bytes]:
+        return _hkdf2(self.ck, b"")
+
+
+def _dh(priv: bytes, pub: bytes) -> bytes:
+    shared = x25519.x25519(priv, pub)
+    if x25519.is_low_order(shared):
+        raise HandshakeError("low-order DH point from peer")
+    return shared
+
+
+# -- socket message framing ---------------------------------------------------
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise HandshakeError(
+                f"peer closed mid-handshake ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def _send_msg(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(struct.pack("<H", len(body)) + body)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    (ln,) = struct.unpack("<H", recv_exact(sock, 2))
+    return recv_exact(sock, ln)
+
+
+# -- the post-handshake record layer ------------------------------------------
+
+class SecureChannel:
+    """One direction-pair of AEAD cipherstates + the negotiated codec.
+
+    ``encrypt``/``decrypt`` operate on whole transport frames and are
+    each single-threaded by construction (transport writer thread /
+    reader thread respectively), so the nonce counters need no locks.
+    """
+
+    def __init__(self, send_key: bytes, recv_key: bytes,
+                 peer_static_pub: bytes, codec_id: int, initiator: bool,
+                 rekey_after: int = REKEY_AFTER_DEFAULT):
+        self._send_key = send_key
+        self._recv_key = recv_key
+        self._send_n = 0
+        self._recv_n = 0
+        self.rekey_after = max(1, int(rekey_after))
+        self.rekeys = 0
+        self.initiator = initiator
+        self.peer_static_pub = peer_static_pub
+        self.peer_id = node_id_of(peer_static_pub)
+        self.codec = codec_mod.Codec(codec_id)
+        self._enc_hist = metrics.histogram(
+            "network_secure_encrypt_seconds",
+            "per-record AEAD seal (incl. codec)")
+        self._dec_hist = metrics.histogram(
+            "network_secure_decrypt_seconds",
+            "per-record AEAD open (incl. codec)")
+
+    @staticmethod
+    def _ratchet(key: bytes) -> bytes:
+        return hmac.new(key, b"rekey", hashlib.sha256).digest()
+
+    def encrypt(self, frame: bytes) -> bytes:
+        """plaintext transport frame → wire record (u32 len | ct)."""
+        t0 = time.perf_counter()
+        pt = self.codec.encode(frame)
+        ct = chacha.seal(self._send_key,
+                         struct.pack("<4xQ", self._send_n), pt)
+        self._send_n += 1
+        if self._send_n >= self.rekey_after:
+            self._send_key = self._ratchet(self._send_key)
+            self._send_n = 0
+            self.rekeys += 1
+        self._enc_hist.observe(time.perf_counter() - t0)
+        return struct.pack("<I", len(ct)) + ct
+
+    def decrypt(self, ct: bytes) -> bytes:
+        """wire record body → plaintext transport frame.  Raises
+        :class:`chacha.AuthError` on tamper/truncation — the transport
+        treats that like any malformed frame: disconnect."""
+        t0 = time.perf_counter()
+        pt = chacha.open_(self._recv_key,
+                          struct.pack("<4xQ", self._recv_n), ct)
+        self._recv_n += 1
+        if self._recv_n >= self.rekey_after:
+            self._recv_key = self._ratchet(self._recv_key)
+            self._recv_n = 0
+        frame = self.codec.decode(pt)
+        self._dec_hist.observe(time.perf_counter() - t0)
+        return frame
+
+
+# -- the two handshake roles --------------------------------------------------
+
+def initiate(sock: socket.socket, static_priv: bytes,
+             expected_peer_id: Optional[bytes] = None,
+             codec_offer: Optional[int] = None,
+             rekey_after: int = REKEY_AFTER_DEFAULT,
+             timeout: float = HANDSHAKE_TIMEOUT_S) -> SecureChannel:
+    """Run the initiator side (the dialing node).
+
+    ``expected_peer_id`` is the node id discovery advertised for this
+    endpoint; a responder whose static key hashes elsewhere aborts the
+    connection (id spoofing), BEFORE we reveal our own static key."""
+    t0 = time.perf_counter()
+    old_to = sock.gettimeout()
+    sock.settimeout(timeout)
+    try:
+        ss = _SymmetricState()
+        offer = codec_mod.supported_mask() if codec_offer is None \
+            else codec_offer
+        ss.mix_hash(bytes([offer & 0xFF]))  # prologue
+        e_priv = _gen_key()
+        e_pub = x25519.pubkey(e_priv)
+        # → msg1: e  (offer byte travels in clear; integrity via prologue)
+        ss.mix_hash(e_pub)
+        _send_msg(sock, bytes([offer & 0xFF]) + e_pub)
+        # ← msg2: e, ee, s, es + codec payload
+        msg2 = _recv_msg(sock)
+        if len(msg2) < 32 + 48 + 17:
+            raise HandshakeError("short handshake message 2")
+        re_pub = msg2[:32]
+        ss.mix_hash(re_pub)
+        ss.mix_key(_dh(e_priv, re_pub))
+        rs_ct, payload_ct = msg2[32:32 + 48], msg2[32 + 48:]
+        rs_pub = ss.decrypt_and_hash(rs_ct)
+        if expected_peer_id is not None \
+                and node_id_of(rs_pub) != bytes(expected_peer_id):
+            raise HandshakeError(
+                "responder static key does not match advertised node id")
+        ss.mix_key(_dh(e_priv, rs_pub))
+        chosen = ss.decrypt_and_hash(payload_ct)
+        if len(chosen) != 1:
+            raise HandshakeError("bad codec payload")
+        codec_id = chosen[0]
+        if not (offer >> codec_id) & 1:
+            # A responder answering a codec we never offered is a
+            # protocol violation — abort loudly.  (Quietly dropping to
+            # identity on our side only would desync the codecs: the
+            # responder would keep compressing and every frame would
+            # die in decode().)  The graceful-degradation path is the
+            # RESPONDER's: choose() picks from the offer∩local
+            # intersection, falling back to identity.
+            raise HandshakeError(f"responder chose un-offered codec "
+                                 f"{codec_id}")
+        # → msg3: s, se
+        s_pub = x25519.pubkey(static_priv)
+        body = ss.encrypt_and_hash(s_pub)
+        ss.mix_key(_dh(static_priv, re_pub))
+        body += ss.encrypt_and_hash(b"")
+        _send_msg(sock, body)
+        k_send, k_recv = ss.split()
+        metrics.observe("network_secure_handshake_seconds",
+                        time.perf_counter() - t0)
+        return SecureChannel(k_send, k_recv, rs_pub, codec_id,
+                             initiator=True, rekey_after=rekey_after)
+    except (OSError, struct.error) as e:
+        raise HandshakeError(f"handshake I/O failed: {e}") from e
+    finally:
+        try:
+            sock.settimeout(old_to)
+        except OSError:
+            pass
+
+
+def respond(sock: socket.socket, static_priv: bytes,
+            rekey_after: int = REKEY_AFTER_DEFAULT,
+            timeout: float = HANDSHAKE_TIMEOUT_S) -> SecureChannel:
+    """Run the responder side (the accepting node)."""
+    t0 = time.perf_counter()
+    old_to = sock.gettimeout()
+    sock.settimeout(timeout)
+    try:
+        ss = _SymmetricState()
+        # ← msg1: offer + e
+        msg1 = _recv_msg(sock)
+        if len(msg1) != 33:
+            raise HandshakeError("bad handshake message 1")
+        offer, re_pub = msg1[0], msg1[1:]
+        ss.mix_hash(bytes([offer]))  # prologue
+        ss.mix_hash(re_pub)
+        # → msg2: e, ee, s, es + chosen codec
+        e_priv = _gen_key()
+        e_pub = x25519.pubkey(e_priv)
+        ss.mix_hash(e_pub)
+        ss.mix_key(_dh(e_priv, re_pub))
+        s_pub = x25519.pubkey(static_priv)
+        body = e_pub + ss.encrypt_and_hash(s_pub)
+        ss.mix_key(_dh(static_priv, re_pub))
+        codec_id = codec_mod.choose(offer)
+        body += ss.encrypt_and_hash(bytes([codec_id]))
+        _send_msg(sock, body)
+        # ← msg3: s, se
+        msg3 = _recv_msg(sock)
+        if len(msg3) < 48 + 16:
+            raise HandshakeError("short handshake message 3")
+        is_pub = ss.decrypt_and_hash(msg3[:48])
+        ss.mix_key(_dh(e_priv, is_pub))
+        ss.decrypt_and_hash(msg3[48:])
+        k_recv, k_send = ss.split()
+        metrics.observe("network_secure_handshake_seconds",
+                        time.perf_counter() - t0)
+        return SecureChannel(k_send, k_recv, is_pub, codec_id,
+                             initiator=False, rekey_after=rekey_after)
+    except (OSError, struct.error) as e:
+        raise HandshakeError(f"handshake I/O failed: {e}") from e
+    finally:
+        try:
+            sock.settimeout(old_to)
+        except OSError:
+            pass
+
+
+def _gen_key() -> bytes:
+    import secrets
+
+    return secrets.token_bytes(32)

@@ -1,0 +1,88 @@
+"""X25519 Diffie-Hellman — RFC 7748 curve25519 scalar multiplication.
+
+The container has no ``cryptography`` package (the hard constraint the
+keystore's :mod:`~lighthouse_tpu.crypto.aes_fallback` already works
+under), so the handshake's DH is pure python: the RFC 7748 §5 Montgomery
+ladder with constant structure (branchless conditional swap on the swap
+bit).  Handshakes are rare — two ladders per connection — so python-int
+field arithmetic is plenty; correctness is pinned to the RFC 7748 §5.2
+scalar-mult vectors and the §6.1 Diffie-Hellman vector in
+``tests/test_secure_channel.py``.
+"""
+
+from __future__ import annotations
+
+P = 2**255 - 19
+_A24 = 121665  # (486662 - 2) / 4
+
+
+def _decode_u(u: bytes) -> int:
+    """Little-endian u-coordinate; the top bit is masked (RFC 7748 §5)."""
+    if len(u) != 32:
+        raise ValueError("X25519 u-coordinate must be 32 bytes")
+    return int.from_bytes(u, "little") & ((1 << 255) - 1)
+
+
+def _decode_scalar(k: bytes) -> int:
+    """Scalar clamping (RFC 7748 §5): clear the 3 low bits, clear bit
+    255, set bit 254."""
+    if len(k) != 32:
+        raise ValueError("X25519 scalar must be 32 bytes")
+    v = int.from_bytes(k, "little")
+    v &= ~7
+    v &= (1 << 255) - 1
+    v |= 1 << 254
+    return v
+
+
+def x25519(k: bytes, u: bytes) -> bytes:
+    """Scalar multiplication k·u → 32-byte shared u-coordinate."""
+    kn = _decode_scalar(k)
+    x1 = _decode_u(u)
+    x2, z2 = 1, 0
+    x3, z3 = x1, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        kt = (kn >> t) & 1
+        swap ^= kt
+        # RFC 7748's cswap; python ints carry no constant-time guarantees
+        # anyway, so the readable branch form is honest here.
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = kt
+        a = (x2 + z2) % P
+        aa = (a * a) % P
+        b = (x2 - z2) % P
+        bb = (b * b) % P
+        e = (aa - bb) % P
+        c = (x3 + z3) % P
+        d = (x3 - z3) % P
+        da = (d * a) % P
+        cb = (c * b) % P
+        x3 = (da + cb) % P
+        x3 = (x3 * x3) % P
+        z3 = (da - cb) % P
+        z3 = (z3 * z3 * x1) % P
+        x2 = (aa * bb) % P
+        z2 = (e * (aa + _A24 * e)) % P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    out = (x2 * pow(z2, P - 2, P)) % P
+    return out.to_bytes(32, "little")
+
+
+_BASE = (9).to_bytes(32, "little")
+
+
+def pubkey(secret: bytes) -> bytes:
+    """Public key = k·9 (the curve's base point u=9)."""
+    return x25519(secret, _BASE)
+
+
+def is_low_order(shared: bytes) -> bool:
+    """An all-zero shared secret means the peer sent a low-order point —
+    RFC 7748 §6.1 mandates aborting (the Noise spec's DH validity
+    check)."""
+    return shared == b"\x00" * 32

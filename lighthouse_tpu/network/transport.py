@@ -5,9 +5,19 @@ Req/Resp streams, discv5 discovery
 (``/root/reference/beacon_node/lighthouse_network/src/rpc/protocol.rs:161-179``).
 This module is the real wire behind this framework's in-process seams: a
 :class:`WireNetwork` owns a TCP listener, speaks length-prefixed SSZ
-frames (snappy is not available in this environment; the framing layer is
-a strict subset of SSZ-snappy minus compression), and serves/issues
-``Status`` + ``BlocksByRange``/``ByRoot`` Req/Resp.
+frames, and serves/issues ``Status`` + ``BlocksByRange``/``ByRoot``
+Req/Resp.
+
+Every connection is ENCRYPTED by default (the libp2p-noise role,
+:mod:`.secure.noise`): dial runs the Noise-XX initiator synchronously,
+accept runs the responder at the top of the connection's reader thread,
+and all frames — gossip, control, Req/Resp — then travel as AEAD records
+(``u32 len | ciphertext``) through the negotiated compression codec
+(:mod:`.secure.codec`).  The node id every score/ban keys on is
+``sha256(static_x25519_pub)[:8]``, so the handshake itself authenticates
+it — a Status frame can no longer claim someone else's identity.
+``secure=False`` (the CLI's ``--insecure``) keeps the legacy plaintext
+framing for debugging and wire-format tests.
 
 Gossip is a degree-bounded mesh, not a flood (VERDICT r4 #6): a 1 s
 heartbeat GRAFTs the best-scoring peers per topic toward D=4 and PRUNEs
@@ -203,17 +213,32 @@ class _Conn:
     """One framed TCP connection: a reader thread plus a writer thread
     draining a BOUNDED send queue (backpressure — VERDICT r4 weak #8).
     A peer that cannot keep up fills its queue and is disconnected
-    instead of blocking the sender or buffering without bound."""
+    instead of blocking the sender or buffering without bound.
+
+    ``channel`` (a :class:`.secure.SecureChannel`) wraps frames into
+    AEAD records.  Dialed conns arrive with the channel ready (the
+    initiator handshake ran synchronously in ``dial``); accepted conns
+    get a ``handshake`` callable the reader thread runs FIRST — the
+    writer holds queued frames behind ``_ready`` until the channel
+    exists, so nothing ever leaves in plaintext on a secure conn."""
 
     SEND_QUEUE_FRAMES = 256
     SEND_QUEUE_BYTES = 4 << 20
+    MAX_RECORD_LEN = 16 << 20
 
-    def __init__(self, sock: socket.socket, on_frame, on_close):
+    def __init__(self, sock: socket.socket, on_frame, on_close,
+                 channel=None, handshake=None, on_secure=None):
         import queue
 
         self.sock = sock
         self._on_frame = on_frame
         self._on_close = on_close
+        self.channel = channel
+        self._handshake = handshake
+        self._on_secure = on_secure
+        self._ready = threading.Event()
+        if handshake is None:
+            self._ready.set()
         self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(
             self.SEND_QUEUE_FRAMES)
         self._q_bytes = 0
@@ -251,14 +276,19 @@ class _Conn:
         raise OSError("peer send queue overflow (slow peer evicted)")
 
     def _writer(self) -> None:
+        self._ready.wait()  # responder handshake may still be running
         while True:
             frame = self._q.get()
             if frame is None:
                 return
             with self._qlock:
                 self._q_bytes -= len(frame)
+            # Encrypt at drain time, on this thread only: the channel's
+            # send nonce counter needs no lock and records hit the wire
+            # in counter order.
+            data = self.channel.encrypt(frame) if self.channel else frame
             try:
-                self.sock.sendall(frame)
+                self.sock.sendall(data)
             except OSError:
                 self.close()
                 return
@@ -274,20 +304,49 @@ class _Conn:
 
     def _reader(self) -> None:
         try:
+            if self._handshake is not None:
+                # Responder role: a dialer that never completes (or
+                # fails) the handshake costs its timeout, then the
+                # socket closes — a truncated handshake cannot hold a
+                # connection slot open.  _ready is set only on SUCCESS;
+                # on failure close() sets it after the socket is closed,
+                # so queued frames can never drain out in plaintext.
+                self.channel = self._handshake(self.sock)
+                self._ready.set()
+                if self._on_secure is not None:
+                    self._on_secure(self)
             while True:
-                hdr = self._recv_exact(5)
-                if hdr is None:
-                    break
-                kind, ln = struct.unpack("<BI", hdr)
-                payload = self._recv_exact(ln)
-                if payload is None:
-                    break
+                if self.channel is not None:
+                    hdr = self._recv_exact(4)
+                    if hdr is None:
+                        break
+                    (rlen,) = struct.unpack("<I", hdr)
+                    if rlen > self.MAX_RECORD_LEN:
+                        break  # length bomb
+                    record = self._recv_exact(rlen)
+                    if record is None:
+                        break
+                    # AuthError (tamper/truncation) propagates to the
+                    # except: disconnect, like any malformed frame.
+                    frame = self.channel.decrypt(record)
+                    kind, ln = struct.unpack_from("<BI", frame, 0)
+                    payload = frame[5:]
+                    if len(payload) != ln:
+                        break  # inner framing inconsistent
+                else:
+                    hdr = self._recv_exact(5)
+                    if hdr is None:
+                        break
+                    kind, ln = struct.unpack("<BI", hdr)
+                    payload = self._recv_exact(ln)
+                    if payload is None:
+                        break
                 self._on_frame(self, kind, payload)
         except Exception:
             # Malformed frames (bad fork id, truncated SSZ, unknown
-            # method) disconnect the peer — a remote can always send
-            # garbage; it must never wedge the reader silently with the
-            # socket left open.
+            # method, failed handshake, AEAD tag mismatch) disconnect
+            # the peer — a remote can always send garbage; it must never
+            # wedge the reader silently with the socket left open.
             pass
         finally:
             self.close()
@@ -298,6 +357,7 @@ class _Conn:
             self.sock.close()
         except OSError:
             pass
+        self._ready.set()  # a closing conn must not strand its writer
         try:
             self._q.put_nowait(None)  # wake the writer to exit
         except Exception:
@@ -403,10 +463,24 @@ class WireNetwork:
     """
 
     def __init__(self, chain, name: str = "node", port: int = 0,
-                 log=None):
+                 log=None, secure: bool = True,
+                 static_key: Optional[bytes] = None,
+                 rekey_after: Optional[int] = None):
         import secrets as _secrets
+
+        from .secure import noise as _noise
+        from .secure import x25519 as _x25519
+
         self.T = chain.T
-        self.node_id = _secrets.token_bytes(8)
+        # Identity: a static X25519 key (persisted by the CLI across
+        # restarts); the node id everyone scores/bans under is its hash,
+        # so under the secure transport identity == key possession.
+        self.secure = secure
+        self.static_priv = static_key or _secrets.token_bytes(32)
+        self.static_pub = _x25519.pubkey(self.static_priv)
+        self.node_id = _noise.node_id_of(self.static_pub)
+        self._noise = _noise
+        self._rekey_after = rekey_after or _noise.REKEY_AFTER_DEFAULT
         self.bus = GossipBus()
         self.node = NetworkNode(chain, self.bus, name=name, log=log)
         self._conns: List[_Conn] = []
@@ -463,23 +537,69 @@ class WireNetwork:
                 sock, _addr = self._listener.accept()
             except OSError:
                 return
-            self._add_conn(sock)
+            self._add_conn(sock, responder=True)
 
-    def _add_conn(self, sock: socket.socket) -> RemotePeer:
-        conn = _Conn(sock, self._on_frame, self._on_close)
+    def _add_conn(self, sock: socket.socket,
+                  channel=None, responder: bool = False) -> RemotePeer:
+        handshake = None
+        on_secure = None
+        if responder and self.secure:
+            handshake = lambda s: self._noise.respond(
+                s, self.static_priv, rekey_after=self._rekey_after)
+            on_secure = self._on_secure
+        conn = _Conn(sock, self._on_frame, self._on_close,
+                     channel=channel, handshake=handshake,
+                     on_secure=on_secure)
         peer = RemotePeer(self, conn)
         with self._lock:
             self._conns.append(conn)
             self._peers[conn] = peer
         self.node.peers.append(peer)
+        if channel is not None:
+            # Initiator: the handshake already authenticated the peer's
+            # node id — bans apply before a single frame is exchanged.
+            self.node.peer_manager.identify(peer, channel.peer_id)
         conn.start()  # only read once the peer maps know this conn
+        if channel is not None and \
+                self.node.peer_manager.is_banned(peer):
+            conn.close()
+            raise OSError("banned peer (handshake identity)")
         return peer
 
-    def dial(self, port: int, host: str = "127.0.0.1") -> RemotePeer:
-        sock = socket.create_connection((host, port))
-        return self._add_conn(sock)
+    def _on_secure(self, conn: _Conn) -> None:
+        """Responder handshake completed: bind the cryptographic node id
+        to the peer handle and enforce bans at the door (`peerdb` ban
+        enforcement, now keyed on a key-derived id)."""
+        peer = self._peers.get(conn)
+        if peer is None:
+            return
+        self.node.peer_manager.identify(peer, conn.channel.peer_id)
+        if self.node.peer_manager.is_banned(peer):
+            conn.close()
 
-    def connect_unique(self, host: str, port: int) -> Optional[RemotePeer]:
+    def dial(self, port: int, host: str = "127.0.0.1",
+             expected_id: Optional[bytes] = None) -> RemotePeer:
+        sock = socket.create_connection((host, port))
+        channel = None
+        if self.secure:
+            try:
+                channel = self._noise.initiate(
+                    sock, self.static_priv, expected_peer_id=expected_id,
+                    rekey_after=self._rekey_after)
+            except self._noise.HandshakeError as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                # Callers (discovery, sync) already handle dial failures
+                # as OSError; an id-spoofing endpoint is just a failed
+                # dial to them.
+                raise OSError(f"secure handshake failed: {e}") from e
+        return self._add_conn(sock, channel=channel)
+
+    def connect_unique(self, host: str, port: int,
+                       expected_id: Optional[bytes] = None,
+                       ) -> Optional[RemotePeer]:
         """Dial unless the target turns out to be this node or an
         already-connected peer: a Status round-trip identifies the remote
         before keeping the connection, so mutual discovery (A and B both
@@ -493,8 +613,8 @@ class WireNetwork:
         existing connection and close both sockets — a permanently
         partitioned pair, since discovery never re-dials a known node id
         (the boot-node mesh flake)."""
-        peer = self.dial(port, host)
-        peer.head_slot()  # Status: fills peer.peer_id
+        peer = self.dial(port, host, expected_id=expected_id)
+        peer.head_slot()  # Status round-trip (fills peer_id when insecure)
         pid = peer.peer_id
         if pid is not None:
             if pid == self.node_id:
@@ -516,11 +636,15 @@ class WireNetwork:
 
     def discover(self, boot_host: str, boot_port: int,
                  interval: float = 2.0):
-        """Join the network via a boot node (`discovery/mod.rs` role):
-        registers this endpoint and dials every fresh record."""
-        from .discovery import DiscoveryService
-        return DiscoveryService(
-            self.node_id, self.port, (boot_host, boot_port),
+        """Join the network via any bootstrap UDP endpoint — a standalone
+        :class:`.discovery.BootNode` or another node's own discovery
+        service (`discovery/mod.rs` role).  Runs the Kademlia table +
+        iterative FINDNODE lookups and dials every fresh record, pinning
+        each dial to the record's node id (the secure handshake aborts on
+        a mismatch)."""
+        from .discovery import KademliaDiscovery
+        return KademliaDiscovery(
+            self.node_id, self.port, [(boot_host, boot_port)],
             dial=self.connect_unique, interval=interval,
             log=self.node.log)
 
@@ -815,13 +939,18 @@ class WireNetwork:
         if method == METHOD_STATUS:
             # The request body carries the CALLER's node id, so bans
             # follow identities across reconnects and a banned node is
-            # dropped at the handshake (`peerdb` ban enforcement).
+            # dropped at the handshake (`peerdb` ban enforcement).  On a
+            # SECURE conn the noise handshake already proved an id — the
+            # cryptographic identity always wins over the claimed one
+            # (a Status body may not re-key a peer to someone else).
             if len(body) >= 8:
                 peer = self._peers.get(conn)
                 if peer is not None:
+                    claimed = conn.channel.peer_id \
+                        if conn.channel is not None else body[:8]
                     # identify() migrates any pre-handshake score to the
                     # stable id (worse score wins — no ban laundering).
-                    self.node.peer_manager.identify(peer, body[:8])
+                    self.node.peer_manager.identify(peer, claimed)
                     if self.node.peer_manager.is_banned(peer):
                         conn.close()
                         raise OSError("banned peer rejected at handshake")

@@ -12,6 +12,7 @@ import hashlib
 
 import numpy as np
 
+from ..common.safe_arith import safe_add, safe_mul
 from ..ssz import Container, Bytes4, Bytes32
 from ..types.chain_spec import (
     Domain,
@@ -31,11 +32,11 @@ def compute_epoch_at_slot(slot: int, slots_per_epoch: int) -> int:
 
 
 def compute_start_slot_at_epoch(epoch: int, slots_per_epoch: int) -> int:
-    return epoch * slots_per_epoch
+    return safe_mul(epoch, slots_per_epoch)
 
 
 def compute_activation_exit_epoch(epoch: int, max_seed_lookahead: int = 4) -> int:
-    return epoch + 1 + max_seed_lookahead
+    return safe_add(safe_add(epoch, 1), max_seed_lookahead)
 
 
 def current_epoch(state, preset) -> int:

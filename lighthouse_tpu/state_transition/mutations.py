@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..common.safe_arith import safe_add, safe_div, safe_mul, safe_sub
 from ..types.chain_spec import (
     FAR_FUTURE_EPOCH,
     ForkName,
@@ -38,10 +39,12 @@ def initiate_validator_exit(state, index: int, preset, spec) -> None:
                                       preset.MAX_SEED_LOOKAHEAD))
     exit_queue_churn = int((pending == np.uint64(exit_queue_epoch)).sum())
     if exit_queue_churn >= get_validator_churn_limit(state, preset, spec):
-        exit_queue_epoch += 1
+        exit_queue_epoch = safe_add(exit_queue_epoch, 1)
     reg.wcol("exit_epoch")[index] = exit_queue_epoch
-    reg.wcol("withdrawable_epoch")[index] = (
-        exit_queue_epoch + spec.min_validator_withdrawability_delay)
+    # `safe_add` discipline: an epoch sum past u64 is an INVALID
+    # operation, not a wrapped uint64 in the column.
+    reg.wcol("withdrawable_epoch")[index] = safe_add(
+        exit_queue_epoch, spec.min_validator_withdrawability_delay)
 
 
 def min_slashing_penalty_quotient(fork: ForkName, preset) -> int:
@@ -72,22 +75,28 @@ def slash_validator(state, slashed_index: int, fork: ForkName, preset, spec,
     reg.wcol("slashed")[slashed_index] = True
     reg.wcol("withdrawable_epoch")[slashed_index] = max(
         int(reg.col("withdrawable_epoch")[slashed_index]),
-        epoch + preset.EPOCHS_PER_SLASHINGS_VECTOR)
+        safe_add(epoch, preset.EPOCHS_PER_SLASHINGS_VECTOR))
     eff = int(reg.col("effective_balance")[slashed_index])
-    state.slashings[epoch % preset.EPOCHS_PER_SLASHINGS_VECTOR] += np.uint64(eff)
+    slot = epoch % preset.EPOCHS_PER_SLASHINGS_VECTOR
+    state.slashings[slot] = np.uint64(
+        safe_add(int(state.slashings[slot]), eff))
     decrease_balance(state, slashed_index,
-                     eff // min_slashing_penalty_quotient(fork, preset))
+                     safe_div(eff, min_slashing_penalty_quotient(fork,
+                                                                 preset)))
 
     if proposer_index is None:
         proposer_index = get_beacon_proposer_index(state, preset)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = eff // preset.WHISTLEBLOWER_REWARD_QUOTIENT
+    whistleblower_reward = safe_div(eff,
+                                    preset.WHISTLEBLOWER_REWARD_QUOTIENT)
     if fork >= ForkName.ALTAIR:
-        proposer_reward = (whistleblower_reward * PROPOSER_WEIGHT
-                           // WEIGHT_DENOMINATOR)
+        proposer_reward = safe_div(
+            safe_mul(whistleblower_reward, PROPOSER_WEIGHT),
+            WEIGHT_DENOMINATOR)
     else:
-        proposer_reward = whistleblower_reward // preset.PROPOSER_REWARD_QUOTIENT
+        proposer_reward = safe_div(whistleblower_reward,
+                                   preset.PROPOSER_REWARD_QUOTIENT)
     increase_balance(state, proposer_index, proposer_reward)
     increase_balance(state, whistleblower_index,
-                     whistleblower_reward - proposer_reward)
+                     safe_sub(whistleblower_reward, proposer_reward))

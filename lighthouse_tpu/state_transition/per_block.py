@@ -17,6 +17,7 @@ from functools import lru_cache
 
 import numpy as np
 
+from ..common.safe_arith import safe_add, safe_div, safe_mul, safe_sub
 from ..crypto import bls as B
 from ..types.chain_spec import (
     FAR_FUTURE_EPOCH,
@@ -368,16 +369,23 @@ def process_attestation(state, attestation, fork, preset, spec, T, acc,
         bit = np.uint8(1 << flag_index)
         fresh = (participation[idx] & bit) == 0
         participation[idx] |= bit
-        proposer_reward_numerator += int(base[idx[fresh]].sum()) * weight
+        # `safe_arith` discipline at the spec seam: the per-flag numerator
+        # is u64 math in the reference; the per-validator base rewards are
+        # summed exactly in python ints first (no u64 wrap possible there).
+        proposer_reward_numerator = safe_add(
+            proposer_reward_numerator,
+            safe_mul(int(base[idx[fresh]].sum()), weight))
 
     if data.target.epoch == cur:
         state.current_epoch_participation = participation
     else:
         state.previous_epoch_participation = participation
 
-    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
-                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
-    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+    proposer_reward_denominator = safe_div(
+        safe_mul(safe_sub(WEIGHT_DENOMINATOR, PROPOSER_WEIGHT),
+                 WEIGHT_DENOMINATOR), PROPOSER_WEIGHT)
+    proposer_reward = safe_div(proposer_reward_numerator,
+                               proposer_reward_denominator)
     increase_balance(state, get_beacon_proposer_index(state, preset),
                      proposer_reward)
 
@@ -468,10 +476,12 @@ def process_attestations_batched(state, attestations, fork, preset, spec, T,
     if not is_cur.all():
         state.previous_epoch_participation = prev_part
 
-    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
-                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_reward_denominator = safe_div(
+        safe_mul(safe_sub(WEIGHT_DENOMINATOR, PROPOSER_WEIGHT),
+                 WEIGHT_DENOMINATOR), PROPOSER_WEIGHT)
     proposer_reward = sum(
-        int(num) // proposer_reward_denominator for num in numerators)
+        safe_div(int(num), proposer_reward_denominator)
+        for num in numerators)
     increase_balance(state, get_beacon_proposer_index(state, preset),
                      proposer_reward)
 
@@ -513,7 +523,7 @@ def apply_deposit(state, data, preset, spec, T) -> None:
         return
     from ..types.validators import Validator
     amount = data.amount
-    eff = min(amount - amount % preset.EFFECTIVE_BALANCE_INCREMENT,
+    eff = min(safe_sub(amount, amount % preset.EFFECTIVE_BALANCE_INCREMENT),
               preset.MAX_EFFECTIVE_BALANCE)
     state.validators.append(Validator(
         pubkey=data.pubkey,
@@ -553,7 +563,8 @@ def process_voluntary_exit(state, signed_exit, fork, preset, spec, acc,
         raise BlockProcessingError("exit: already exiting")
     if epoch < exit.epoch:
         raise BlockProcessingError("exit: not yet valid")
-    if epoch < int(reg.col("activation_epoch")[idx]) + spec.shard_committee_period:
+    if epoch < safe_add(int(reg.col("activation_epoch")[idx]),
+                        spec.shard_committee_period):
         raise BlockProcessingError("exit: validator too young")
     acc.add(sigs.voluntary_exit_signature_set(state, signed_exit,
                                               pubkey_cache, preset))
@@ -592,13 +603,18 @@ def process_sync_aggregate(state, aggregate, preset, spec, T, acc) -> None:
     total = get_total_active_balance(state, preset)
     from .per_epoch import base_reward_per_increment
     per_inc = base_reward_per_increment(total, preset)
-    total_increments = total // preset.EFFECTIVE_BALANCE_INCREMENT
-    total_base_rewards = per_inc * total_increments
-    max_participant_rewards = (total_base_rewards * 2 // WEIGHT_DENOMINATOR
-                               // preset.SLOTS_PER_EPOCH)
-    participant_reward = max_participant_rewards // preset.SYNC_COMMITTEE_SIZE
-    proposer_reward = (participant_reward * PROPOSER_WEIGHT
-                       // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+    # Spec u64 math end-to-end (`safe_arith` seam): any overflow is an
+    # invalid operation, never a wrapped reward.
+    total_increments = safe_div(total, preset.EFFECTIVE_BALANCE_INCREMENT)
+    total_base_rewards = safe_mul(per_inc, total_increments)
+    max_participant_rewards = safe_div(
+        safe_div(safe_mul(total_base_rewards, 2), WEIGHT_DENOMINATOR),
+        preset.SLOTS_PER_EPOCH)
+    participant_reward = safe_div(max_participant_rewards,
+                                  preset.SYNC_COMMITTEE_SIZE)
+    proposer_reward = safe_div(
+        safe_mul(participant_reward, PROPOSER_WEIGHT),
+        safe_sub(WEIGHT_DENOMINATOR, PROPOSER_WEIGHT))
 
     proposer = get_beacon_proposer_index(state, preset)
     bits = np.asarray(aggregate.sync_committee_bits, dtype=bool)
@@ -742,7 +758,8 @@ def get_expected_withdrawals_scalar(state, preset) -> list:
         elif (has_eth1 and eff == preset.MAX_EFFECTIVE_BALANCE
               and balance > preset.MAX_EFFECTIVE_BALANCE):
             withdrawals.append((withdrawal_index, validator_index, cred[12:],
-                                balance - preset.MAX_EFFECTIVE_BALANCE))
+                                safe_sub(balance,
+                                         preset.MAX_EFFECTIVE_BALANCE)))
             withdrawal_index += 1
         validator_index = (validator_index + 1) % n
     return withdrawals
@@ -778,8 +795,8 @@ def get_expected_withdrawals(state, preset) -> list:
     wi = state.next_withdrawal_index
     for k, t in enumerate(hits):
         amount = int(balance[t]) if full[t] \
-            else int(balance[t]) - preset.MAX_EFFECTIVE_BALANCE
-        withdrawals.append((wi + k, int(order[t]),
+            else safe_sub(int(balance[t]), preset.MAX_EFFECTIVE_BALANCE)
+        withdrawals.append((safe_add(wi, k), int(order[t]),
                             creds[t, 12:].tobytes(), amount))
     return withdrawals
 
@@ -793,7 +810,7 @@ def process_withdrawals(state, payload, preset, T) -> None:
     for (_, vidx, _, amount) in expected:
         decrease_balance(state, vidx, amount)
     if expected:
-        state.next_withdrawal_index = expected[-1][0] + 1
+        state.next_withdrawal_index = safe_add(expected[-1][0], 1)
     n = len(state.validators)
     if len(expected) == preset.MAX_WITHDRAWALS_PER_PAYLOAD:
         state.next_withdrawal_validator_index = \

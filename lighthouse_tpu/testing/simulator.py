@@ -59,8 +59,13 @@ class SimNode:
 
 
 class Simulator:
+    """``secure=True`` (the default) runs every inter-node TCP byte
+    through the noise-xx AEAD channel — the production shape; the CLI's
+    ``--insecure`` escape hatch maps to ``secure=False`` for wire-format
+    debugging."""
+
     def __init__(self, n_nodes: int = 3, n_validators: int = 16,
-                 preset=None):
+                 preset=None, secure: bool = True):
         from .harness import StateHarness
         from ..types.presets import MINIMAL
 
@@ -81,7 +86,7 @@ class Simulator:
                 genesis_state=h.state.copy(),
                 genesis_block_root=genesis_root,
                 preset=h.preset, spec=h.spec, T=h.T)
-            net = WireNetwork(chain, name=f"node{i}")
+            net = WireNetwork(chain, name=f"node{i}", secure=secure)
             disco = net.discover("127.0.0.1", self.boot.port, interval=0.2)
             lo = i * share
             hi = n_validators if i == n_nodes - 1 else lo + share
@@ -152,10 +157,13 @@ def main() -> int:
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--validators", type=int, default=16)
     ap.add_argument("--slots", type=int, default=12)
+    ap.add_argument("--insecure", action="store_true",
+                    help="plaintext transport (wire debugging)")
     args = ap.parse_args()
 
     B.set_backend("fake")
-    sim = Simulator(n_nodes=args.nodes, n_validators=args.validators)
+    sim = Simulator(n_nodes=args.nodes, n_validators=args.validators,
+                    secure=not args.insecure)
     try:
         assert sim.wait_for_mesh(), "discovery mesh failed"
         sim.run(args.slots)

@@ -2,11 +2,13 @@
 
 Same strategy as ``test_pairing_kernel_cpu.py``: bind the packed constant
 planes and drive the EXACT in-kernel helpers eagerly against the host
-oracles.  The ladder-heavy pieces (the 758-bit SSWU sqrt, the psi cofactor
-ladders, the full final exponentiation) compile for minutes on CPU XLA, so
-they run only when ``RUN_SLOW_KERNEL_TESTS=1`` (CI fast path covers the
-ladder-free algebra; the on-chip path is validated by
-``tests/test_pairing_kernel.py`` / ``bench.py`` on the real device).
+oracles.  The consensus-critical ladders (``k_sswu_map``,
+``k_clear_cofactor``, ``k_final_exp_cubed``) run UN-GATED at reduced
+width — one point, not a plane — in the default suite (VERDICT r5 item
+9: the device curve code needs standing verification without the chip);
+the full-width plane drives stay behind ``RUN_SLOW_KERNEL_TESTS=1``
+(eager ladder cost is per-op, so extra lanes buy little extra signal for
+minutes of extra wall-clock).
 """
 
 import os
@@ -25,9 +27,11 @@ from lighthouse_tpu.crypto import htc_kernel as HK
 random.seed(0xBEEF)
 
 SLOW = os.environ.get("RUN_SLOW_KERNEL_TESTS") != "1"
-slow = pytest.mark.skipif(
-    SLOW, reason="ladder kernels cost minutes of CPU XLA compile; "
-                 "set RUN_SLOW_KERNEL_TESTS=1 (on-chip path covers them)")
+full_width = pytest.mark.skipif(
+    SLOW, reason="full-width ladder planes cost extra minutes of eager "
+                 "CPU drive; the un-gated single-point variants cover "
+                 "the same code — set RUN_SLOW_KERNEL_TESTS=1 for the "
+                 "plane shapes")
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -89,20 +93,16 @@ def test_k_psi_matches_host():
         assert (F.fq2_mul(Xs[i], zi), F.fq2_mul(Ys[i], zi)) == want
 
 
-@slow
-def test_k_sswu_map_matches_host():
-    ts = [_rand_fq2() for _ in range(2)] + [(0, 0)]
+def _drive_sswu(ts):
     x, y = HK.k_sswu_map(_fq2_plane(ts))
     got = list(zip(_fq2_from(x), _fq2_from(y)))
     for i, t in enumerate(ts):
         assert got[i] == H.map_to_curve_sswu(t), f"lane {i}"
 
 
-@slow
-def test_k_clear_cofactor_matches_host():
-    pts = [H.iso_map(H.map_to_curve_sswu(_rand_fq2())) for _ in range(2)]
+def _drive_clear_cofactor(pts):
     proj = (_fq2_plane([p[0] for p in pts]), _fq2_plane([p[1] for p in pts]),
-            _fq2_plane([F.FQ2_ONE] * 2))
+            _fq2_plane([F.FQ2_ONE] * len(pts)))
     out = HK.k_clear_cofactor(proj)
     Xs, Ys, Zs = _fq2_from(out[0]), _fq2_from(out[1]), _fq2_from(out[2])
     for i, p in enumerate(pts):
@@ -111,26 +111,59 @@ def test_k_clear_cofactor_matches_host():
         assert (F.fq2_mul(Xs[i], zi), F.fq2_mul(Ys[i], zi)) == want
 
 
-@slow
-def test_k_final_exp_cubed_matches_host():
+def _fq12_plane(vals):
+    return tuple(
+        tuple(_fq2_plane([v[i][j] for v in vals]) for j in range(3))
+        for i in range(2))
+
+
+def _fq12_from(p):
+    out = []
+    n = np.asarray(p[0][0][0]).shape[1]
+    cs = [[_fq2_from(p[i][j]) for j in range(3)] for i in range(2)]
+    for m in range(n):
+        out.append(tuple(tuple(cs[i][j][m] for j in range(3))
+                         for i in range(2)))
+    return out
+
+
+def _drive_final_exp(vals):
     from lighthouse_tpu.crypto.pairing import final_exponentiation_cubed
 
-    def _fq12_plane(vals):
-        return tuple(
-            tuple(_fq2_plane([v[i][j] for v in vals]) for j in range(3))
-            for i in range(2))
-
-    def _fq12_from(p):
-        out = []
-        n = np.asarray(p[0][0][0]).shape[1]
-        cs = [[_fq2_from(p[i][j]) for j in range(3)] for i in range(2)]
-        for m in range(n):
-            out.append(tuple(tuple(cs[i][j][m] for j in range(3))
-                             for i in range(2)))
-        return out
-
-    vals = [tuple(tuple(_rand_fq2() for _ in range(3)) for _ in range(2))
-            for _ in range(2)]
     got = _fq12_from(PK.k_final_exp_cubed(_fq12_plane(vals)))
     for g, v in zip(got, vals):
         assert g == final_exponentiation_cubed(v)
+
+
+# Un-gated single-point ladder drives: the exact in-kernel sqrt/psi/
+# final-exp code paths execute in every default (full) suite run.
+
+def test_k_sswu_map_single_point():
+    _drive_sswu([_rand_fq2()])
+
+
+def test_k_clear_cofactor_single_point():
+    _drive_clear_cofactor([H.iso_map(H.map_to_curve_sswu(_rand_fq2()))])
+
+
+def test_k_final_exp_cubed_single_value():
+    _drive_final_exp([tuple(tuple(_rand_fq2() for _ in range(3))
+                            for _ in range(2))])
+
+
+@full_width
+def test_k_sswu_map_matches_host():
+    _drive_sswu([_rand_fq2() for _ in range(2)] + [(0, 0)])
+
+
+@full_width
+def test_k_clear_cofactor_matches_host():
+    _drive_clear_cofactor(
+        [H.iso_map(H.map_to_curve_sswu(_rand_fq2())) for _ in range(2)])
+
+
+@full_width
+def test_k_final_exp_cubed_matches_host():
+    _drive_final_exp(
+        [tuple(tuple(_rand_fq2() for _ in range(3)) for _ in range(2))
+         for _ in range(2)])

@@ -1,0 +1,438 @@
+"""Secure p2p subsystem unit tier: RFC-pinned primitives, the noise-xx
+handshake's failure modes (tamper, truncation, id spoofing), codec
+negotiation, rekey-on-overflow, and the Kademlia k-bucket table.
+
+Everything here is pure python/numpy — no JAX compile — and quick-marked
+via conftest's auto-marking (this module is not in _SLOW_MODULES).
+"""
+
+import secrets
+import socket
+import struct
+import threading
+
+import pytest
+
+from lighthouse_tpu.network.secure import chacha, codec, kademlia, noise, x25519
+
+
+# ---------------------------------------------------------------------------
+# RFC 7748 — X25519
+# ---------------------------------------------------------------------------
+
+def test_x25519_rfc7748_scalar_mult_vectors():
+    # §5.2 vector 1
+    k = bytes.fromhex("a546e36bf0527c9d3b16154b82465edd"
+                      "62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex("e6db6867583030db3594c1a424b15f7c"
+                      "726624ec26b3353b10a903a6d0ab1c4c")
+    want = bytes.fromhex("c3da55379de9c6908e94ea4df28d084f"
+                         "32eccf03491c71f754b4075577a28552")
+    assert x25519.x25519(k, u) == want
+    # §5.2 vector 2
+    k = bytes.fromhex("4b66e9d4d1b4673c5ad22691957d6af5"
+                      "c11b6421e0ea01d42ca4169e7918ba0d")
+    u = bytes.fromhex("e5210f12786811d3f4b7959d0538ae2c"
+                      "31dbe7106fc03c3efc4cd549c715a493")
+    want = bytes.fromhex("95cbde9476e8907d7aade45cb4b873f8"
+                         "8b595a68799fa152e6f8f7647aac7957")
+    assert x25519.x25519(k, u) == want
+
+
+def test_x25519_rfc7748_diffie_hellman_vector():
+    # §6.1
+    a = bytes.fromhex("77076d0a7318a57d3c16c17251b26645"
+                      "df4c2f87ebc0992ab177fba51db92c2a")
+    b = bytes.fromhex("5dab087e624a8a4b79e17f8b83800ee6"
+                      "6f3bb1292618b6fd1c2f8b27ff88e0eb")
+    a_pub = bytes.fromhex("8520f0098930a754748b7ddcb43ef75a"
+                          "0dbf3a0d26381af4eba4a98eaa9b4e6a")
+    b_pub = bytes.fromhex("de9edb7d7b7dc1b4d35b61c2ece43537"
+                          "3f8343c85b78674dadfc7e146f882b4f")
+    shared = bytes.fromhex("4a5d9d5ba4ce2de1728e3bf480350f25"
+                           "e07e21c947d19e3376f09b3c1e161742")
+    assert x25519.pubkey(a) == a_pub
+    assert x25519.pubkey(b) == b_pub
+    assert x25519.x25519(a, b_pub) == shared
+    assert x25519.x25519(b, a_pub) == shared
+
+
+def test_x25519_low_order_point_detected():
+    zero_u = b"\x00" * 32
+    assert x25519.is_low_order(
+        x25519.x25519(secrets.token_bytes(32), zero_u))
+
+
+# ---------------------------------------------------------------------------
+# RFC 8439 — ChaCha20 / Poly1305 / AEAD
+# ---------------------------------------------------------------------------
+
+_SUNSCREEN = (b"Ladies and Gentlemen of the class of '99: If I could "
+              b"offer you only one tip for the future, sunscreen would "
+              b"be it.")
+
+
+def test_chacha20_block_rfc8439():
+    # §2.3.2
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000090000004a00000000")
+    block = chacha.chacha20_block(key, 1, nonce)
+    want = bytes.fromhex(
+        "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+        "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e")
+    assert block == want
+
+
+def test_chacha20_encryption_rfc8439():
+    # §2.4.2
+    key = bytes(range(32))
+    nonce = bytes.fromhex("000000000000004a00000000")
+    ct = chacha.chacha20_xor(key, 1, nonce, _SUNSCREEN)
+    assert ct[:32] == bytes.fromhex(
+        "6e2e359a2568f98041ba0728dd0d6981e97e7aec1d4360c20a27afccfd9fae0b")
+    # involution
+    assert chacha.chacha20_xor(key, 1, nonce, ct) == _SUNSCREEN
+
+
+def test_poly1305_rfc8439():
+    # §2.5.2
+    key = bytes.fromhex("85d6be7857556d337f4452fe42d506a8"
+                        "0103808afb0db2fd4abff6af4149f51b")
+    tag = chacha.poly1305(key, b"Cryptographic Forum Research Group")
+    assert tag == bytes.fromhex("a8061dc1305136c6c22b8baf0c0127a9")
+
+
+def test_aead_rfc8439_seal_open():
+    # §2.8.2
+    key = bytes.fromhex("808182838485868788898a8b8c8d8e8f"
+                        "909192939495969798999a9b9c9d9e9f")
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    sealed = chacha.seal(key, nonce, _SUNSCREEN, aad)
+    assert sealed[-16:] == bytes.fromhex(
+        "1ae10b594f09e26a7e902ecbd0600691")
+    assert chacha.open_(key, nonce, sealed, aad) == _SUNSCREEN
+
+
+def test_aead_rejects_tamper_truncation_and_aad_mismatch():
+    key = secrets.token_bytes(32)
+    nonce = b"\x00" * 12
+    sealed = chacha.seal(key, nonce, b"payload", aad=b"ctx")
+    flipped = sealed[:-1] + bytes([sealed[-1] ^ 1])
+    with pytest.raises(chacha.AuthError):
+        chacha.open_(key, nonce, flipped, aad=b"ctx")
+    with pytest.raises(chacha.AuthError):
+        chacha.open_(key, nonce, sealed[:10], aad=b"ctx")  # truncated
+    with pytest.raises(chacha.AuthError):
+        chacha.open_(key, nonce, sealed, aad=b"other")
+    with pytest.raises(chacha.AuthError):
+        chacha.open_(key, nonce, b"", aad=b"ctx")  # shorter than a tag
+
+
+# ---------------------------------------------------------------------------
+# Noise-XX handshake + record layer
+# ---------------------------------------------------------------------------
+
+def _handshake_pair(initiator_key=None, responder_key=None,
+                    expected_peer_id=None, rekey_after=1 << 20):
+    s_i = initiator_key or secrets.token_bytes(32)
+    s_r = responder_key or secrets.token_bytes(32)
+    a, b = socket.socketpair()
+    out = {}
+
+    def _respond():
+        try:
+            out["r"] = noise.respond(b, s_r, rekey_after=rekey_after)
+        except Exception as e:  # surfaced by the caller via out
+            out["r_err"] = e
+
+    t = threading.Thread(target=_respond)
+    t.start()
+    try:
+        ch_i = noise.initiate(a, s_i, expected_peer_id=expected_peer_id,
+                              rekey_after=rekey_after)
+    finally:
+        # close the initiator side FIRST so an aborted handshake EOFs
+        # the responder immediately instead of running out its timeout
+        # (buffered socketpair data stays readable after close)
+        a.close()
+        t.join(10)
+        b.close()
+    if "r_err" in out:
+        raise out["r_err"]
+    return ch_i, out["r"], s_i, s_r
+
+
+def test_handshake_binds_node_ids_both_ways():
+    ch_i, ch_r, s_i, s_r = _handshake_pair()
+    assert ch_i.peer_id == noise.node_id_of(x25519.pubkey(s_r))
+    assert ch_r.peer_id == noise.node_id_of(x25519.pubkey(s_i))
+    # and the channel interoperates in both directions
+    rec = ch_i.encrypt(b"ping")
+    assert ch_r.decrypt(rec[4:]) == b"ping"
+    rec = ch_r.encrypt(b"pong")
+    assert ch_i.decrypt(rec[4:]) == b"pong"
+
+
+def test_handshake_with_expected_id_accepts_the_right_key():
+    s_r = secrets.token_bytes(32)
+    rid = noise.node_id_of(x25519.pubkey(s_r))
+    ch_i, ch_r, _, _ = _handshake_pair(responder_key=s_r,
+                                       expected_peer_id=rid)
+    assert ch_i.peer_id == rid
+
+
+def test_wrong_static_key_aborts_as_id_spoof():
+    """Discovery advertised node id X; the endpoint holds a different
+    static key — the initiator must abort before sending its own static
+    key (message 3 never goes out)."""
+    wrong_id = noise.node_id_of(x25519.pubkey(secrets.token_bytes(32)))
+    with pytest.raises(noise.HandshakeError, match="node id"):
+        _handshake_pair(expected_peer_id=wrong_id)
+
+
+def test_truncated_handshake_rejected():
+    a, b = socket.socketpair()
+    err = {}
+
+    def _respond():
+        try:
+            noise.respond(b, secrets.token_bytes(32), timeout=5.0)
+        except noise.HandshakeError as e:
+            err["e"] = e
+
+    t = threading.Thread(target=_respond)
+    t.start()
+    # half of message 1, then EOF
+    a.sendall(struct.pack("<H", 33) + b"\x01" + b"\xab" * 10)
+    a.close()
+    t.join(10)
+    b.close()
+    assert "e" in err
+
+
+def test_handshake_times_out_on_a_silent_dialer():
+    a, b = socket.socketpair()
+    with pytest.raises(noise.HandshakeError):
+        noise.respond(b, secrets.token_bytes(32), timeout=0.3)
+    a.close()
+    b.close()
+
+
+def test_tampered_handshake_static_rejected():
+    """Flipping a bit in msg2's encrypted static key must fail the
+    initiator's AEAD, not hand it a wrong identity."""
+    a, b = socket.socketpair()
+    s_r = secrets.token_bytes(32)
+
+    def _mitm_respond():
+        try:
+            # run a normal responder but corrupt its msg2 on the wire:
+            # intercept by wrapping sendall once.
+            real_sendall = b.sendall
+            state = {"n": 0}
+
+            def tampering_sendall(data):
+                state["n"] += 1
+                if state["n"] == 1:  # msg2
+                    data = bytearray(data)
+                    data[2 + 32 + 5] ^= 0x40  # inside the s ciphertext
+                    data = bytes(data)
+                real_sendall(data)
+
+            b.sendall = tampering_sendall  # type: ignore[assignment]
+            noise.respond(b, s_r, timeout=5.0)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=_mitm_respond)
+    t.start()
+    with pytest.raises(noise.HandshakeError):
+        noise.initiate(a, secrets.token_bytes(32), timeout=5.0)
+    t.join(10)
+    a.close()
+    b.close()
+
+
+def test_record_layer_rejects_tampered_ciphertext():
+    ch_i, ch_r, _, _ = _handshake_pair()
+    rec = ch_i.encrypt(b"frame")[4:]
+    with pytest.raises(chacha.AuthError):
+        ch_r.decrypt(rec[:-1] + bytes([rec[-1] ^ 1]))
+
+
+def test_record_layer_rejects_replay():
+    """The receive nonce advances per record, so a replayed record hits
+    a different nonce and fails authentication."""
+    ch_i, ch_r, _, _ = _handshake_pair()
+    rec = ch_i.encrypt(b"frame")[4:]
+    assert ch_r.decrypt(rec) == b"frame"
+    with pytest.raises(chacha.AuthError):
+        ch_r.decrypt(rec)
+
+
+def test_rekey_on_nonce_overflow():
+    ch_i, ch_r, _, _ = _handshake_pair(rekey_after=4)
+    k0 = ch_i._send_key
+    for i in range(13):
+        msg = b"frame-%d" % i
+        assert ch_r.decrypt(ch_i.encrypt(msg)[4:]) == msg
+    assert ch_i.rekeys == 3  # 13 records / 4-per-key
+    assert ch_i._send_key != k0
+    # the other direction rekeys independently
+    for i in range(5):
+        msg = b"back-%d" % i
+        assert ch_i.decrypt(ch_r.encrypt(msg)[4:]) == msg
+    assert ch_r.rekeys == 1
+
+
+# ---------------------------------------------------------------------------
+# Codec negotiation
+# ---------------------------------------------------------------------------
+
+def test_codec_identity_roundtrip_and_metrics():
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    c = codec.Codec(codec.CODEC_IDENTITY)
+    raw0 = REGISTRY.counter("network_codec_raw_bytes_total").value
+    frame = b"x" * 300
+    assert c.decode(c.encode(frame)) == frame
+    assert REGISTRY.counter(
+        "network_codec_raw_bytes_total").value == raw0 + 300
+
+
+def test_codec_negotiation_mismatch_falls_back_to_identity(monkeypatch):
+    """One side offers snappy, the other can't speak it — both must land
+    on identity and traffic flows."""
+    # Responder chooses from the INTERSECTION:
+    offer = (1 << codec.CODEC_IDENTITY) | (1 << codec.CODEC_SNAPPY)
+    assert codec.choose(offer, local_mask=1 << codec.CODEC_IDENTITY) \
+        == codec.CODEC_IDENTITY
+    # identity-only offer against a snappy-capable responder:
+    assert codec.choose(1 << codec.CODEC_IDENTITY,
+                        local_mask=offer) == codec.CODEC_IDENTITY
+    # and over a real handshake with a snappy-less environment, the
+    # negotiated channel is identity on both ends:
+    ch_i, ch_r, _, _ = _handshake_pair()
+    assert ch_i.codec.codec_id == codec.CODEC_IDENTITY
+    assert ch_r.codec.codec_id == codec.CODEC_IDENTITY
+
+
+def test_codec_rogue_responder_choice_aborts(monkeypatch):
+    """A responder answering a codec id the initiator never offered is a
+    protocol violation: the handshake aborts (silently dropping to
+    identity on one side only would desync the codec seam)."""
+    # choose() itself can never return an un-offered codec ...
+    assert codec.choose(1 << codec.CODEC_IDENTITY) == codec.CODEC_IDENTITY
+    # ... so fake a rogue responder by breaking choose() and watch the
+    # initiator's guard fire.
+    monkeypatch.setattr(noise.codec_mod, "choose",
+                        lambda offer, local_mask=None: 7)
+    with pytest.raises(noise.HandshakeError, match="un-offered codec"):
+        _handshake_pair()
+
+
+def test_codec_rejects_compressed_frames_on_identity():
+    c = codec.Codec(codec.CODEC_IDENTITY)
+    with pytest.raises(ValueError):
+        c.decode(bytes([codec.FLAG_COMPRESSED]) + b"\x00\x01")
+    with pytest.raises(ValueError):
+        c.decode(b"")
+
+
+# ---------------------------------------------------------------------------
+# Kademlia k-bucket table + lookup state
+# ---------------------------------------------------------------------------
+
+def _cid(i: int) -> bytes:
+    return struct.pack(">Q", i)
+
+
+def _contact(i: int, tcp: int = 1000) -> kademlia.Contact:
+    return kademlia.Contact(_cid(i), "127.0.0.1", 40000 + i, tcp)
+
+
+def test_kbucket_insert_and_mru_ordering():
+    table = kademlia.KBucketTable(_cid(0), k=3)
+    for i in (0b100, 0b101, 0b110):
+        assert table.update(_contact(i)) is None
+    assert len(table) == 3
+    bucket = table.buckets[2]  # distance bit 2
+    assert [c.node_id for c in bucket] == [_cid(0b100), _cid(0b101),
+                                           _cid(0b110)]
+    # refreshing an existing contact moves it to MRU, no eviction
+    assert table.update(_contact(0b100)) is None
+    assert [c.node_id for c in table.buckets[2]] == [
+        _cid(0b101), _cid(0b110), _cid(0b100)]
+
+
+def test_kbucket_full_bucket_returns_lru_candidate_and_evicts():
+    table = kademlia.KBucketTable(_cid(0), k=3)
+    for i in (0b100, 0b101, 0b110):
+        table.update(_contact(i))
+    cand = table.update(_contact(0b111))  # full bucket
+    assert cand is not None and cand.node_id == _cid(0b100)  # LRU
+    assert len(table) == 3  # newcomer NOT stored yet (liveness bias)
+    # the liveness ping failed → evict LRU, admit the newcomer
+    assert table.evict(cand.node_id)
+    assert table.update(_contact(0b111)) is None
+    ids = {c.node_id for c in table.buckets[2]}
+    assert ids == {_cid(0b101), _cid(0b110), _cid(0b111)}
+
+
+def test_kbucket_never_tracks_self():
+    table = kademlia.KBucketTable(_cid(7))
+    assert table.update(kademlia.Contact(_cid(7), "127.0.0.1", 1, 1)) \
+        is None
+    assert len(table) == 0
+
+
+def test_kbucket_closest_orders_by_xor_distance():
+    table = kademlia.KBucketTable(_cid(0), k=16)
+    for i in (1, 2, 3, 8, 12, 200, 1 << 40):
+        table.update(_contact(i))
+    target = _cid(9)
+    got = [c.node_id for c in table.closest(target, 3)]
+    want = sorted((_cid(i) for i in (1, 2, 3, 8, 12, 200, 1 << 40)),
+                  key=lambda nid: kademlia.xor_distance(nid, target))[:3]
+    assert got == want  # 8 (d=1), 12 (d=5), 1 (d=8)
+
+
+def test_kbucket_refresh_bookkeeping_and_random_target():
+    table = kademlia.KBucketTable(_cid(0))
+    table.update(_contact(0b100))
+    assert table.stale_buckets(max_age=0.0) == [2]
+    table.mark_lookup(_cid(0b101))  # lands in bucket 2
+    assert table.stale_buckets(max_age=60.0) == []
+    for i in (2, 5, 40):
+        rid = table.random_id_in_bucket(i)
+        assert table._bucket_index(rid) == i
+
+
+def test_lookup_state_iterates_toward_target_and_converges():
+    target = _cid(1)
+    seeds = [_contact(1 << 30), _contact(1 << 20)]
+    st = kademlia.LookupState(target, seeds, k=4, alpha=2)
+    batch = st.next_batch()
+    assert [c.node_id for c in batch] == [_cid(1 << 20), _cid(1 << 30)]
+    # first responses surface closer nodes → they are queried next
+    fresh = st.absorb([_contact(3), _contact(1 << 10)])
+    assert len(fresh) == 2
+    assert not st.done()
+    batch = st.next_batch()
+    assert batch[0].node_id == _cid(3)
+    st.absorb([_contact(3)])  # duplicate: not fresh
+    assert st.absorb([_contact(3)]) == []
+    while not st.done():
+        if not st.next_batch():
+            break
+    result = st.result()
+    assert result[0].node_id == _cid(3)  # closest seen to target
+
+
+def test_node_id_is_key_derived():
+    sk = secrets.token_bytes(32)
+    import hashlib
+
+    assert noise.node_id_of(x25519.pubkey(sk)) == hashlib.sha256(
+        x25519.pubkey(sk)).digest()[:8]

@@ -32,12 +32,19 @@ def test_three_node_network_finalizes():
 
 @pytest.mark.timeout(300)
 def test_network_with_hostile_peers_finalizes():
-    """VERDICT r4 #6 'done' criterion: a network with one spamming and
-    one stalling peer still finalizes, and the spammer ends banned."""
+    """VERDICT r4 #6 'done' criterion, now on the ENCRYPTED transport:
+    a network with one spamming and one stalling peer still finalizes,
+    and the spammer ends banned.  The spammer completes a real noise
+    handshake (hostility inside the AEAD channel must be scored exactly
+    like plaintext hostility was); the staller never handshakes — a
+    truncated handshake may not hold resources past its timeout."""
+    import secrets
     import socket
     import struct
     import threading
     import time
+
+    from lighthouse_tpu.network.secure import noise
 
     # 6 honest wire nodes + the spammer + the staller = the 8-node
     # hostile drill from VERDICT r4 #6.
@@ -46,15 +53,17 @@ def test_network_with_hostile_peers_finalizes():
         assert sim.wait_for_mesh()
         target = sim.nodes[0].net
 
-        # Spammer: valid framing, junk topics/bodies, high rate.
+        # Spammer: real handshake, then junk topics/bodies, high rate.
         spam = socket.create_connection(("127.0.0.1", target.port))
+        spam_ch = noise.initiate(spam, secrets.token_bytes(32))
 
         def spam_loop():
             junk = b"\x07garbage" + b"\xff" * 64  # topic 'garbage'
             frame = struct.pack("<BI", 0, len(junk)) + junk
             try:
                 for _ in range(300):
-                    spam.sendall(frame * 4)
+                    for _ in range(4):
+                        spam.sendall(spam_ch.encrypt(frame))
                     time.sleep(0.01)
             except OSError:
                 pass
@@ -62,7 +71,7 @@ def test_network_with_hostile_peers_finalizes():
         t = threading.Thread(target=spam_loop, daemon=True)
         t.start()
 
-        # Staller: connects and never reads nor responds.
+        # Staller: connects and never even handshakes.
         stall = socket.create_connection(("127.0.0.1", sim.nodes[1].net.port))
 
         sim.run(32)

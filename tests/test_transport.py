@@ -2,15 +2,23 @@
 
 VERDICT r3 item 10 — real sockets behind the GossipBus/ReqResp seams (the
 in-process architecture unchanged); the 2-process version of this test is
-``scripts/two_node_testnet.py``.
+``scripts/two_node_testnet.py``.  All of it runs over the DEFAULT
+noise-xx encrypted transport; a sniffing test asserts no plaintext SSZ
+ever reaches the wire, and the hostile scenarios (malformed frames,
+spam/rate-limits, slow-peer eviction) drive the AEAD channel through a
+real handshaking client.
 """
 
+import secrets
+import socket
+import struct
 import time
 
 import pytest
 
 from lighthouse_tpu.beacon_chain import BeaconChain
 from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.network.secure import noise
 from lighthouse_tpu.network.transport import WireNetwork
 from lighthouse_tpu.store import HotColdDB
 from lighthouse_tpu.testing.harness import StateHarness
@@ -24,12 +32,25 @@ def fake_backend():
     B.set_backend("python")
 
 
-def _node(h):
+def _node(h, secure=True):
     chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
                         genesis_state=h.state.copy(),
                         genesis_block_root=_genesis_root(h),
                         preset=h.preset, spec=h.spec, T=h.T)
-    return WireNetwork(chain, name=f"n{id(chain) % 97}")
+    return WireNetwork(chain, name=f"n{id(chain) % 97}", secure=secure)
+
+
+def _secure_client(port):
+    """A raw TCP client that completes the noise handshake — the hostile
+    scenarios' way onto the encrypted wire."""
+    sock = socket.create_connection(("127.0.0.1", port))
+    channel = noise.initiate(sock, secrets.token_bytes(32))
+    return sock, channel
+
+
+def _client_send(sock, channel, kind, payload):
+    frame = struct.pack("<BI", kind, len(payload)) + payload
+    sock.sendall(channel.encrypt(frame))
 
 
 def _genesis_root(h):
@@ -180,12 +201,10 @@ def test_sync_committee_messages_cross_wire():
 
 
 def test_slow_peer_evicted_on_send_queue_overflow(monkeypatch):
-    """Backpressure (VERDICT r4 weak #8): a peer that stops draining its
-    socket fills the bounded send queue and is evicted, not buffered
-    without bound."""
-    import socket
-    import time
-
+    """Backpressure (VERDICT r4 weak #8), now over the ENCRYPTED
+    transport: a fully handshaked peer that stops draining its socket
+    fills the bounded send queue and is evicted, not buffered without
+    bound (the AEAD layer must not exempt anyone from eviction)."""
     from lighthouse_tpu.network import transport as TR
 
     monkeypatch.setattr(TR._Conn, "SEND_QUEUE_BYTES", 1 << 16)
@@ -194,8 +213,8 @@ def test_slow_peer_evicted_on_send_queue_overflow(monkeypatch):
     h = StateHarness(n_validators=16, preset=MINIMAL)
     net = _node(h)
     try:
-        # Raw client that never reads.
-        sock = socket.create_connection(("127.0.0.1", net.port))
+        # Handshaked client that never reads afterwards.
+        sock, _ch = _secure_client(net.port)
         deadline = time.time() + 10
         while time.time() < deadline and not net._conns:
             time.sleep(0.01)
@@ -216,6 +235,152 @@ def test_slow_peer_evicted_on_send_queue_overflow(monkeypatch):
         sock.close()
     finally:
         net.close()
+
+
+def test_no_plaintext_ssz_on_the_wire():
+    """Acceptance criterion: sniff every byte the gossiping node hands to
+    TCP and assert the block's SSZ serialization never appears — then
+    prove the sniffer works by seeing the plaintext under --insecure."""
+    def run(secure):
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        a = _node(h, secure=secure)
+        b = _node(h, secure=secure)
+        captured = bytearray()
+        try:
+            b.dial(a.port)
+            assert _wait(lambda: a.node.peers and b.node.peers)
+            class _Tee:
+                def __init__(self, sock):
+                    self._sock = sock
+
+                def sendall(self, data):
+                    captured.extend(data)
+                    return self._sock.sendall(data)
+
+                def __getattr__(self, name):
+                    return getattr(self._sock, name)
+
+            for conn in list(a._conns):  # tee a's outbound bytes
+                conn.sock = _Tee(conn.sock)
+            sb = h.build_block()
+            h.apply_block(sb)
+            a.node.chain.per_slot_task(int(sb.message.slot))
+            b.node.chain.per_slot_task(int(sb.message.slot))
+            a.publish_block(sb)
+            assert _wait(lambda: (b.node.processor.run_until_idle() or True)
+                         and b.node.chain.head.slot == int(sb.message.slot))
+            ssz = type(sb).serialize(sb)
+            return bytes(captured), ssz
+        finally:
+            a.close()
+            b.close()
+
+    wire, ssz = run(secure=True)
+    assert wire, "sniffer captured nothing"
+    assert ssz not in wire, "plaintext SSZ leaked on the secure wire"
+    # an 80-byte window of the block must not appear either (framing
+    # could split the full serialization across records)
+    assert ssz[8:88] not in wire
+    wire, ssz = run(secure=False)
+    assert ssz in wire, "sniffer failed to see plaintext on --insecure"
+
+
+def test_tampered_record_disconnects_peer():
+    """A ciphertext bit-flip fails the AEAD tag and the transport treats
+    it like any malformed frame: disconnect."""
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    net = _node(h)
+    try:
+        sock, channel = _secure_client(net.port)
+        assert _wait(lambda: net._conns)
+        frame = struct.pack("<BI", 0, 8) + b"\x07garbage"
+        record = bytearray(channel.encrypt(frame))
+        record[-1] ^= 0x01
+        sock.sendall(bytes(record))
+        sock.settimeout(10)
+        closed = False
+        try:
+            while sock.recv(1 << 16) != b"":
+                pass
+            closed = True
+        except OSError:
+            closed = True
+        assert closed, "node kept a tampering peer connected"
+        sock.close()
+    finally:
+        net.close()
+
+
+def test_junk_gossip_over_encrypted_channel_walks_to_ban():
+    """Malformed frames + spam INSIDE the AEAD channel: junk topics are
+    penalized per frame, the score crosses the ban threshold, and the
+    heartbeat disconnects — rate-limiting runs on plaintext frames after
+    decrypt, unchanged by the crypto layer."""
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    net = _node(h)
+    try:
+        sock, channel = _secure_client(net.port)
+        assert _wait(lambda: net._conns)
+        junk = b"\x07garbage" + b"\xff" * 64  # unknown topic 'garbage'
+        closed = False
+        sock.settimeout(15)
+        try:
+            for i in range(400):
+                _client_send(sock, channel, 0, junk + bytes([i % 251]))
+                time.sleep(0.002)
+        except OSError:
+            closed = True
+        if not closed:
+            try:
+                while sock.recv(1 << 16) != b"":
+                    pass
+                closed = True
+            except OSError:
+                closed = True
+        assert closed, "spamming peer was never disconnected"
+        peer = next(iter(net.node.peer_manager._info.values()), None)
+        assert peer is not None and peer.score < 0
+        sock.close()
+    finally:
+        net.close()
+
+
+def test_bootstrap_via_peer_of_a_peer():
+    """Acceptance criterion: C's config knows only B; A is known only to
+    B.  C's iterative k-bucket lookup walks B's FINDNODE response and
+    dials A — no flat registry involved (no BootNode in this test)."""
+    from lighthouse_tpu.network.discovery import KademliaDiscovery
+
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    a = _node(h)
+    b = _node(h)
+    c = _node(h)
+    discos = []
+    try:
+        da = KademliaDiscovery(a.node_id, a.port, [],
+                               dial=a.connect_unique, interval=0.2)
+        discos.append(da)
+        db = KademliaDiscovery(b.node_id, b.port,
+                               [("127.0.0.1", da.udp_port)],
+                               dial=b.connect_unique, interval=0.2)
+        discos.append(db)
+        # B finds and dials A first (so A is "known only to B")
+        assert _wait(lambda: any(p.peer_id == a.node_id
+                                 for p in b.node.peers), timeout=30.0)
+        dc = KademliaDiscovery(c.node_id, c.port,
+                               [("127.0.0.1", db.udp_port)],
+                               dial=c.connect_unique, interval=0.2)
+        discos.append(dc)
+        assert _wait(lambda: {p.peer_id for p in c.node.peers} >=
+                     {a.node_id, b.node_id}, timeout=60.0)
+        # and A's k-bucket table learned C through the lookup traffic
+        assert _wait(lambda: dc.table.get(a.node_id) is not None,
+                     timeout=30.0)
+    finally:
+        for d in discos:
+            d.close()
+        for n in (a, b, c):
+            n.close()
 
 
 def test_light_client_updates_cross_the_wire():
