@@ -126,19 +126,24 @@ class PublicKey:
         return C.g1_compress(self.point)
 
 
-def aggregate_public_keys(keys: Sequence[PublicKey]):
-    """G1 sum of pubkey points (keys pre-validated at deserialization).
+def aggregate_points(points):
+    """G1 sum of pre-validated pubkey POINTS.
 
     Large sums route through the native jacobian accumulator when built
     (~5 µs/point vs ~500 µs python affine adds) — the sync-committee
     512-key aggregate drops from ~260 ms to ~3 ms."""
     from . import native
-    if len(keys) >= 16 and native.ready():
-        return native.g1_aggregate([k.point for k in keys])
+    if len(points) >= 16 and native.ready():
+        return native.g1_aggregate(list(points))
     acc = None
-    for k in keys:
-        acc = C.g1_add(acc, k.point)
+    for p in points:
+        acc = C.g1_add(acc, p)
     return acc
+
+
+def aggregate_public_keys(keys: Sequence[PublicKey]):
+    """G1 sum of pubkey points (keys pre-validated at deserialization)."""
+    return aggregate_points([k.point for k in keys])
 
 
 @dataclass(frozen=True)

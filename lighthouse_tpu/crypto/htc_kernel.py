@@ -54,6 +54,7 @@ def _htc_const_specs():
     return [pl.BlockSpec(memory_space=pltpu.VMEM),   # consts
             pl.BlockSpec(memory_space=pltpu.SMEM),   # x bits
             pl.BlockSpec(memory_space=pltpu.SMEM),   # p−2 bits
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # band-sel matrix
             pl.BlockSpec(memory_space=pltpu.SMEM)]   # e16 bits
 
 
@@ -243,8 +244,9 @@ def k_clear_cofactor(p):
     return point_add(_G2ops, acc, k_psi(k_psi(point_add(_G2ops, p, p))))
 
 
-def _hash_g2_kernel(cref, xbits_ref, pbits_ref, e16_ref, u_ref, out_ref):
-    _bind_consts(cref, xbits_ref, pbits_ref)
+def _hash_g2_kernel(cref, xbits_ref, pbits_ref, band_ref, e16_ref, u_ref,
+                    out_ref):
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     _KC["e16"] = e16_ref
     # in_mosaic is a trace-time flag: scope it to this trace so an eager /
     # interpret drive of the k_* helpers afterwards doesn't inherit it

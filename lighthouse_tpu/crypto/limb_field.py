@@ -203,6 +203,87 @@ def _band_columns(a: jnp.ndarray, b: jnp.ndarray, ncols: int) -> jnp.ndarray:
     return jnp.sum(jnp.stack(parts), axis=0)
 
 
+# ---------------------------------------------------------------------------
+# MXU band products — the schoolbook column accumulation as ONE matmul
+# ---------------------------------------------------------------------------
+#
+# The pad-and-sum tree above is pure VPU work: ~104 full-plane pads + adds
+# per band product, and three band products per mont_mul.  Hardware pairing
+# engines win by feeding the wide multiplier structured limb products
+# ("A Low-Power BLS12-381 Pairing Crypto-Processor", arXiv:2201.07496);
+# the TPU analogue is the MXU.  The accumulation
+#     T[k] = Σ_{i+j=k} lo(a_i·b_j)  +  Σ_{i+j+1=k} hi(a_i·b_j)
+# is a CONSTANT 0/1 contraction over the 2·26·26 = 1352 partial terms, so
+# the whole band collapses to one (batch, 1352) × (1352, ncols) matmul
+# against a fixed selection matrix.  Exactness: every partial term is
+# < 2^16 (exact in f32) and every column accumulates ≤ 52 of them
+# (< 2^22 < 2^24, the f32 integer-exact range), so the f32 MXU result is
+# bit-exact — asserted against the VPU path in tests/test_bls_shard.py
+# and scripts/validate_bls_shard.py.
+#
+# Default: on for the TPU backend, off elsewhere (the CPU "matmul" would
+# just be a slower BLAS call); override with LIGHTHOUSE_TPU_MXU=0/1.
+
+_MXU_FLAG: bool | None = None
+
+
+def use_mxu() -> bool:
+    """Whether band products route through the MXU matmul formulation."""
+    global _MXU_FLAG
+    if _MXU_FLAG is None:
+        import os
+        v = os.environ.get("LIGHTHOUSE_TPU_MXU", "auto").lower()
+        if v in ("auto", ""):
+            import jax
+            _MXU_FLAG = jax.default_backend() == "tpu"
+        else:
+            _MXU_FLAG = v not in ("0", "off", "false", "no")
+    return _MXU_FLAG
+
+
+def band_sel_matrix(ncols: int) -> np.ndarray:
+    """(2·26·26, ncols) f32 selection matrix: row i·26+j → column i+j
+    (lo half), row 676+i·26+j → column i+j+1 (hi half)."""
+    sel = np.zeros((2 * LIMBS * LIMBS, ncols), np.float32)
+    for i in range(LIMBS):
+        for j in range(LIMBS):
+            if i + j < ncols:
+                sel[i * LIMBS + j, i + j] = 1.0
+            if i + j + 1 < ncols:
+                sel[LIMBS * LIMBS + i * LIMBS + j, i + j + 1] = 1.0
+    return sel
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=4)
+def _band_sel_dev(ncols: int):
+    return jnp.asarray(band_sel_matrix(ncols))
+
+
+def _band_columns_mxu(a: jnp.ndarray, b: jnp.ndarray,
+                      ncols: int) -> jnp.ndarray:
+    """MXU twin of :func:`_band_columns` — identical column values."""
+    import jax
+    prod = a[..., :, None] * b[..., None, :]          # (..., 26, 26) < 2^32
+    lead = prod.shape[:-2]
+    lo = (prod & MASK).astype(jnp.float32).reshape(lead + (LIMBS * LIMBS,))
+    hi = ((prod >> np.uint32(LIMB_BITS))
+          .astype(jnp.float32).reshape(lead + (LIMBS * LIMBS,)))
+    feat = jnp.concatenate([lo, hi], axis=-1)         # (..., 1352)
+    t = jax.lax.dot_general(
+        feat, _band_sel_dev(ncols),
+        dimension_numbers=(((feat.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+    return t.astype(jnp.uint32)
+
+
+def _band(a: jnp.ndarray, b: jnp.ndarray, ncols: int) -> jnp.ndarray:
+    return (_band_columns_mxu if use_mxu() else _band_columns)(a, b, ncols)
+
+
 def _carry_cols(t: jnp.ndarray, ncols: int, keep_carry: bool) -> jnp.ndarray:
     """Normalize ``ncols`` uint32 columns (< 2^23) to 16-bit limbs; the final
     carry is appended iff ``keep_carry`` (else reduced mod 2^(16·ncols))."""
@@ -232,11 +313,11 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     both the XLA compiler and the VPU prefer.  Bound: T < 4N², so
     (T + mN)/R < 4N²/R + N < 2N because R = 2^416 ≈ 2^35·N.
     """
-    t = _band_columns(a, b, 2 * LIMBS)                 # T columns
+    t = _band(a, b, 2 * LIMBS)                         # T columns
     t_low = _carry_cols(t[..., :LIMBS], LIMBS, keep_carry=False)
-    m = _carry_cols(_band_columns(t_low, jnp.asarray(_NPRIME_LIMBS), LIMBS),
+    m = _carry_cols(_band(t_low, jnp.asarray(_NPRIME_LIMBS), LIMBS),
                     LIMBS, keep_carry=False)           # m = T·N' mod R
-    u = _band_columns(m, jnp.asarray(N_LIMBS), 2 * LIMBS)
+    u = _band(m, jnp.asarray(N_LIMBS), 2 * LIMBS)
     s = _carry_cols(t + u, 2 * LIMBS, keep_carry=True)  # (T + mN), exact
     return s[..., LIMBS:2 * LIMBS]                      # / R  (low half ≡ 0)
 

@@ -327,13 +327,17 @@ def _product_reduce(f: jnp.ndarray) -> jnp.ndarray:
     return f[0]
 
 
-def multi_pairing_is_one(g1_proj: jnp.ndarray, g2_proj: jnp.ndarray,
-                         mask: jnp.ndarray) -> jnp.ndarray:
-    """∏_{i: mask_i} e(P_i, Q_i) == 1, fused on device.
+def multi_pairing_partial(g1_proj: jnp.ndarray, g2_proj: jnp.ndarray,
+                          mask: jnp.ndarray) -> jnp.ndarray:
+    """∏_{i: mask_i} f_{|x|}(P_i, Q_i) — the masked Miller-lane product
+    WITHOUT the final exponentiation, as one (2, 3, 2, 26) Fq12.
 
-    ``g1_proj``: (B, 3, 26); ``g2_proj``: (B, 3, 2, 26); ``mask``: (B,) bool.
-    B must be a power of two.  Lanes where either point is the identity
-    contribute 1 (e(O, ·) = e(·, O) = 1), as do masked padding lanes.
+    This is the per-chip half of the mesh-sharded batch verify: each
+    chip folds its shard's lanes to a single Fq12 partial, the partials
+    all-gather (5 KB/chip), and ONE replicated final exponentiation
+    closes the product — the product-of-pairings trick stretched across
+    the ICI.  Shapes as :func:`multi_pairing_is_one`; B a power of two;
+    identity lanes and masked padding contribute 1.
     """
     g1_aff = g1_proj_to_affine(g1_proj)
     g2_aff = g2_proj_to_affine(g2_proj)
@@ -343,5 +347,16 @@ def multi_pairing_is_one(g1_proj: jnp.ndarray, g2_proj: jnp.ndarray,
             & ~T.fq2_is_zero(g2_proj[..., 2, :, :]))
     one = jnp.asarray(T.FQ12_ONE_LIMBS)
     f = jnp.where(live[:, None, None, None, None], f, one)
-    prod = _product_reduce(f)
+    return _product_reduce(f)
+
+
+def multi_pairing_is_one(g1_proj: jnp.ndarray, g2_proj: jnp.ndarray,
+                         mask: jnp.ndarray) -> jnp.ndarray:
+    """∏_{i: mask_i} e(P_i, Q_i) == 1, fused on device.
+
+    ``g1_proj``: (B, 3, 26); ``g2_proj``: (B, 3, 2, 26); ``mask``: (B,) bool.
+    B must be a power of two.  Lanes where either point is the identity
+    contribute 1 (e(O, ·) = e(·, O) = 1), as do masked padding lanes.
+    """
+    prod = multi_pairing_partial(g1_proj, g2_proj, mask)
     return fq12_is_one(final_exponentiation_cubed(prod))

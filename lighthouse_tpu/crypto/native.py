@@ -53,10 +53,30 @@ def _source_tag() -> str:
     return h.hexdigest()[:16]
 
 
+def _prune_stale(keep: str) -> None:
+    """Delete completed build artifacts for OTHER source/CPU tags — the
+    loader keys on the current tag, so they are dead weight that
+    otherwise accumulates forever (and must never be committed:
+    ``native/*.so`` is gitignored).  ``.tmp*`` files are deliberately
+    NOT touched: one may be a CONCURRENT builder's in-progress output
+    (deleting it would break its atomic ``os.replace``).  ``_build``
+    unlinks its own tmp on failure; only a hard mid-build crash can
+    orphan one."""
+    import glob
+    for path in glob.glob(os.path.join(_DIR, "libbls381-*.so")):
+        if os.path.abspath(path) == os.path.abspath(keep):
+            continue
+        try:
+            os.unlink(path)
+        except OSError:
+            pass  # concurrent process still loading it
+
+
 def _build() -> Optional[str]:
     tag = _source_tag()
     so = os.path.join(_DIR, f"libbls381-{tag}.so")
     if os.path.exists(so):
+        _prune_stale(so)
         return so
     tmp = so + ".tmp%d" % os.getpid()
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
@@ -64,8 +84,22 @@ def _build() -> Optional[str]:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)  # partial compiler output
+        except OSError:
+            pass
         return None
-    os.replace(tmp, so)  # atomic vs concurrent builders
+    try:
+        os.replace(tmp, so)  # atomic vs concurrent builders
+    except OSError:
+        # Our finished build can't land (e.g. unwritable dir entry).
+        # Don't leak it as an orphan the prune pass never touches.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return so if os.path.exists(so) else None
+    _prune_stale(so)
     return so
 
 

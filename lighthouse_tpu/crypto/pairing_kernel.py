@@ -132,11 +132,16 @@ def _build_consts() -> tuple[np.ndarray, dict]:
 
 CONSTS_PLANES, _CONST_INDEX = _build_consts()
 
+# (52, 1352) f32 selection matrix for the MXU band product (transposed so
+# the in-kernel contraction is SEL @ feat → (52, M) — see k_band_mxu).
+BAND_SEL_T = LF.band_sel_matrix(2 * LIMBS).T.copy()
+
 # Bound during kernel tracing: name → plane value; plus bit-string refs.
 _KC: dict = {}
 
 
-def _bind_consts(cref, xbits_ref=None, pbits_ref=None) -> None:
+def _bind_consts(cref, xbits_ref=None, pbits_ref=None,
+                 band_ref=None) -> None:
     c = cref[:]
     for name, (a, b) in _CONST_INDEX.items():
         _KC[name] = c[a:b]
@@ -146,6 +151,9 @@ def _bind_consts(cref, xbits_ref=None, pbits_ref=None) -> None:
                   for j in range(3)) for i in range(2))
     _KC["xbits"] = xbits_ref
     _KC["pbits"] = pbits_ref
+    # MXU band-selection matrix; None (eager/legacy drives) falls back to
+    # the VPU pad-and-add band inside k_mont_mul.
+    _KC["band"] = band_ref
     # Default OFF: only the hash-to-curve kernel trace flips this (its
     # pltpu.repeat materialization is Mosaic-only); re-binding here keeps
     # the process-global flag from leaking into later eager/CPU drives.
@@ -155,13 +163,15 @@ def _bind_consts(cref, xbits_ref=None, pbits_ref=None) -> None:
 def _const_specs():
     return [pl.BlockSpec(memory_space=pltpu.VMEM),   # consts
             pl.BlockSpec(memory_space=pltpu.SMEM),   # x bits
-            pl.BlockSpec(memory_space=pltpu.SMEM)]   # p−2 bits
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # p−2 bits
+            pl.BlockSpec(memory_space=pltpu.VMEM)]   # band-sel matrix
 
 
 def _const_args():
     return (jnp.asarray(CONSTS_PLANES),
             jnp.asarray(X_BITS_FULL.reshape(-1, 1).astype(np.int32)),
-            jnp.asarray(P_MINUS_2_BITS.reshape(-1, 1).astype(np.int32)))
+            jnp.asarray(P_MINUS_2_BITS.reshape(-1, 1).astype(np.int32)),
+            jnp.asarray(BAND_SEL_T))
 
 
 # ---------------------------------------------------------------------------
@@ -247,6 +257,38 @@ def k_band(a, b, ncols: int):
     return t
 
 
+def k_band_mxu(a, b, ncols: int):
+    """MXU band product on planes: the column accumulation of
+    :func:`k_band` as ONE (ncols, 1352) × (1352, M) f32 matmul against
+    the bound selection matrix (:data:`BAND_SEL_T`).  Exact: partial
+    terms < 2^16, column sums ≤ 52 terms < 2^22 — inside f32's
+    integer-exact range; bit-identical to :func:`k_band` (asserted in
+    tests/test_bls_shard.py and scripts/validate_bls_shard.py)."""
+    # [:ncols] both LOADS the bound Ref (dot_general rejects raw Refs)
+    # and drops the rows a narrow band never needs (ncols=26 halves the
+    # m-band matmul).  Prefix slices keep the sublane offset at 0.
+    sel = _KC["band"][:ncols]
+    los, his = [], []
+    for i in range(LIMBS):
+        p = a[i:i + 1] * b                  # row i of the outer product
+        los.append(p & M16)
+        his.append(p >> np.uint32(16))
+    feat = jnp.concatenate(los + his, axis=0).astype(jnp.float32)
+    t = jax.lax.dot_general(
+        sel, feat, dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)  # (ncols, M)
+    return t.astype(U32)
+
+
+def _k_band_any(a, b, ncols: int):
+    """Band-product dispatch: MXU matmul when enabled AND a selection
+    matrix rode in with the consts; VPU pad-and-add otherwise."""
+    if LF.use_mxu() and _KC.get("band") is not None:
+        return k_band_mxu(a, b, ncols)
+    return k_band(a, b, ncols)
+
+
 def k_mont_mul(a, b):
     """Batched Montgomery product on planes — same algorithm and bounds as
     :func:`..limb_field.mont_mul` (full-width reduction).
@@ -255,10 +297,10 @@ def k_mont_mul(a, b):
     concat: slicing rows [26:52] out of a 53-row array would give the
     value a sublane-offset layout, which poisons every later lane-concat
     it reaches (Mosaic can't mix offset layouts in one concatenate)."""
-    t = k_band(a, b, 2 * LIMBS)
+    t = _k_band_any(a, b, 2 * LIMBS)
     t_low = k_carry(t[:LIMBS], LIMBS)
-    m = k_carry(k_band(t_low, _KC["NPRIME"], LIMBS), LIMBS)
-    u = k_band(m, _KC["N"], 2 * LIMBS)
+    m = k_carry(_k_band_any(t_low, _KC["NPRIME"], LIMBS), LIMBS)
+    u = _k_band_any(m, _KC["N"], 2 * LIMBS)
     s = t + u
     rows = []
     c = jnp.zeros_like(s[0:1])
@@ -697,14 +739,14 @@ def _miller_add_step(f, T2, Qx, Qy, Q, xP, yP):
     return fq12_mul(f, l_add), T3
 
 
-def _miller_kernel(cref, xbits_ref, pbits_ref, g1_ref, g2_ref, out_ref):
+def _miller_kernel(cref, xbits_ref, pbits_ref, band_ref, g1_ref, g2_ref, out_ref):
     """One 63-iteration fori; the add-step runs under ``lax.cond`` on the
     static bit, so the 58 zero bits of |x| (Hamming weight 6) skip the
     add-step's ~38% of the loop's products instead of computing and
     discarding it.  (A fully segment-unrolled variant blew the 16 MB
     scoped-VMEM budget — straight-line segments keep too many
     simultaneously-live buffers; the cond body stays loop-scoped.)"""
-    _bind_consts(cref, xbits_ref, pbits_ref)
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     xP, yP = unpack_planes(g1_ref[:], 2)
     Qx, Qy = unpack_fq2s(g2_ref[:], 2)
     m = xP.shape[1]
@@ -756,7 +798,9 @@ def _const_block_specs():
     cs = CONSTS_PLANES.shape[0]
     return [pl.BlockSpec((cs, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM)]
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(BAND_SEL_T.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)]
 
 
 # ---------------------------------------------------------------------------
@@ -764,9 +808,9 @@ def _const_block_specs():
 # ---------------------------------------------------------------------------
 
 
-def _product_kernel(cref, xbits_ref, pbits_ref, f_ref, mask_ref, out_ref,
-                    *, lanes: int):
-    _bind_consts(cref, xbits_ref, pbits_ref)
+def _product_kernel(cref, xbits_ref, pbits_ref, band_ref, f_ref, mask_ref,
+                    out_ref, *, lanes: int):
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     f = unpack_fq12(f_ref[:])
     mask = mask_ref[:]
     f = fq12_select(mask != 0, f, fq12_one_like(lanes))
@@ -806,11 +850,11 @@ def product_kernel_call(f_planes, mask):
     )(*_const_args(), f_planes, mask)
 
 
-def _product_chunk_kernel(cref, xbits_ref, pbits_ref, f_ref, mask_ref,
-                          out_ref):
+def _product_chunk_kernel(cref, xbits_ref, pbits_ref, band_ref, f_ref,
+                          mask_ref, out_ref):
     """One 256-lane chunk → 128 residue-class products (lane j and j+128
     hold the same value after the fold; only [0:128] is written)."""
-    _bind_consts(cref, xbits_ref, pbits_ref)
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     f = unpack_fq12(f_ref[:])
     mask = mask_ref[:]
     f = fq12_select(mask != 0, f, fq12_one_like(2 * LANE_BLOCK))
@@ -846,6 +890,69 @@ def product_chunks_kernel_call(f_planes, mask):
     )(*_const_args(), f_planes, mask)
 
 
+def _miller_fold_kernel(cref, xbits_ref, pbits_ref, band_ref, g1_ref,
+                        g2_ref, mask_ref, out_ref):
+    """FUSED Miller scan + masked per-chunk lane fold: one 256-lane cell
+    runs the 63-iteration Miller loop AND the 256→128 residue-class
+    product in the same program, so the σ/RLC product fold stops being a
+    separate dispatch (VERDICT r5 item 2).  The fold reuses the Miller
+    loop's VMEM-resident f — no (384, 256) HBM round-trip between the
+    two stages."""
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
+    xP, yP = unpack_planes(g1_ref[:], 2)
+    Qx, Qy = unpack_fq2s(g2_ref[:], 2)
+    m = xP.shape[1]                     # 2 · LANE_BLOCK lanes per cell
+    Q = (Qx, Qy, _G2ops.one_like(m))
+    f0 = fq12_one_like(m)
+    xbits = _KC["xbits"]
+
+    def body(i, carry):
+        f, T = carry
+        f, T = _miller_dbl_step(f, T, xP, yP)
+        bit = xbits[i + 1, 0]           # skip the implicit leading 1
+        return jax.lax.cond(
+            bit == 1,
+            lambda f, T: _miller_add_step(f, T, Qx, Qy, Q, xP, yP),
+            lambda f, T: (f, T),
+            f, T)
+
+    f, _ = jax.lax.fori_loop(0, X_BITS_MILLER.shape[0], body, (f0, Q))
+    f = fq12_conj(f)                    # x < 0
+    f = fq12_select(mask_ref[:] != 0, f, fq12_one_like(m))
+    f = fq12_mul(f, _fq12_roll(f, LANE_BLOCK))
+    half = tuple(tuple((c0[:, :LANE_BLOCK], c1[:, :LANE_BLOCK])
+                       for (c0, c1) in c6) for c6 in f)
+    out_ref[:] = pack_fq12(half)
+
+
+@jax.jit
+def miller_fold_kernel_call(g1_planes, g2_planes, mask):
+    """g1 (64, C·256) affine blocks, g2 (128, C·256), mask (1, C·256)
+    int32 → (384, C·128) folded residue-class products — the fused twin
+    of :func:`miller_kernel_call` + :func:`product_chunks_kernel_call`.
+    The output feeds :func:`finalize_kernel_call` directly."""
+    m = g1_planes.shape[1]
+    if m % (2 * LANE_BLOCK):
+        raise ValueError("pad fused miller lanes to a multiple of 256")
+    C = m // (2 * LANE_BLOCK)
+    return pl.pallas_call(
+        _miller_fold_kernel,
+        grid=(C,),
+        in_specs=_const_specs() + [
+            pl.BlockSpec((2 * BLOCK_ROWS, 2 * LANE_BLOCK), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4 * BLOCK_ROWS, 2 * LANE_BLOCK), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2 * LANE_BLOCK), lambda c: (0, c),
+                         memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((12 * BLOCK_ROWS, LANE_BLOCK), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((12 * BLOCK_ROWS, C * LANE_BLOCK),
+                                       jnp.uint32),
+        compiler_params=_COMPILER_PARAMS,
+    )(*_const_args(), g1_planes, g2_planes, mask)
+
+
 # ---------------------------------------------------------------------------
 # Sigma kernel: per-chunk RLC-scaled signature aggregation (G2)
 # ---------------------------------------------------------------------------
@@ -859,9 +966,9 @@ def product_chunks_kernel_call(f_planes, mask):
 # aggregate to a dedicated Miller cell paired with the constant −G.
 
 
-def _sigma_kernel(cref, xbits_ref, pbits_ref, sig_ref, mask_ref, lo_ref,
-                  hi_ref, out_ref):
-    _bind_consts(cref, xbits_ref, pbits_ref)
+def _sigma_kernel(cref, xbits_ref, pbits_ref, band_ref, sig_ref, mask_ref,
+                  lo_ref, hi_ref, out_ref):
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     S = PREP_S
     cols = unpack_fq2s(sig_ref[:], 2)  # [x, y] as Fq2 planes
     live = mask_ref[:] != 0
@@ -996,12 +1103,12 @@ def _fq12_roll(f, w: int):
                        for (c0, c1) in c6) for c6 in f)
 
 
-def _finalize_easy_kernel(cref, xbits_ref, pbits_ref, f_ref, out_ref):
+def _finalize_easy_kernel(cref, xbits_ref, pbits_ref, band_ref, f_ref, out_ref):
     """(384, 128) residue-class products (dead lanes already 1) → full
     lane fold + the EASY part of the final exponentiation
     (f^((q⁶−1)(q²+1)), which needs the true Fq12 inverse).  Split from
     the hard part so each program stays within the scoped-VMEM budget."""
-    _bind_consts(cref, xbits_ref, pbits_ref)
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     f = unpack_fq12(f_ref[:])
     w = f[0][0][0].shape[1] // 2
     while w >= 1:
@@ -1010,9 +1117,9 @@ def _finalize_easy_kernel(cref, xbits_ref, pbits_ref, f_ref, out_ref):
     out_ref[:] = pack_fq12(k_final_exp_easy(f))
 
 
-def _finalize_hard_kernel(cref, xbits_ref, pbits_ref, m_ref, out_ref):
+def _finalize_hard_kernel(cref, xbits_ref, pbits_ref, band_ref, m_ref, out_ref):
     """Easy-part output → HHT hard part ×3 → ``∏ == 1`` int32 flag."""
-    _bind_consts(cref, xbits_ref, pbits_ref)
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     m = unpack_fq12(m_ref[:])
     g = k_final_exp_hard(m)
     ok = fq12_is_one(g).astype(jnp.int32)  # (1, 128); all lanes equal
@@ -1088,9 +1195,9 @@ finalize_kernel_call_donated = jax.jit(_finalize_call_body,
 PREP_S = 128  # sets per prepare launch (lane-block aligned)
 
 
-def _prepare_kernel(cref, xbits_ref, pbits_ref, pk_ref, kmask_ref, lo_ref,
-                    hi_ref, g1_out_ref, flags_ref, *, K: int):
-    _bind_consts(cref, xbits_ref, pbits_ref)
+def _prepare_kernel(cref, xbits_ref, pbits_ref, band_ref, pk_ref, kmask_ref,
+                    lo_ref, hi_ref, g1_out_ref, flags_ref, *, K: int):
+    _bind_consts(cref, xbits_ref, pbits_ref, band_ref)
     S = PREP_S
     acc = point_identity(_G1ops, S)
 

@@ -3,10 +3,18 @@ measured decomposition VERDICT r4 #2 asks for in the bench JSON.
 
 The production path is ONE jit (a single host sync), so stage costs are
 measured by queueing each kernel N× and syncing once (amortizing the
-~100 ms axon tunnel roundtrip to <10 ms/row of noise).  Shapes match the
-256-set C=2 bucket; inputs are synthetic limb planes — the kernels'
-CORRECTNESS is pinned elsewhere (host oracles + RFC anchors); this
-measures device time only.
+~100 ms axon tunnel roundtrip to <10 ms/row of noise).  Shapes default to
+the 256-set C=2 bucket (comparable with the r5 baselines: final_exp
+51.7 ms / HTC 44.3 ms / Miller 32.4 ms) and can be widened to the C=8
+bucket the 1024-set row now dispatches as one program; inputs are
+synthetic limb planes — the kernels' CORRECTNESS is pinned elsewhere
+(host oracles + RFC anchors); this measures device time only.
+
+Stages:
+
+- the r5-comparable unfused rows (``miller`` / ``product_fold``), and
+- ``miller_fold_fused`` — the fused Miller+fold program that replaced
+  the two separate dispatches in the production pipeline.
 
 Used by ``bench.py`` (the ``bls_stage_split`` row) and
 ``scripts/profile_bls.py`` (human-readable breakdown).
@@ -18,8 +26,8 @@ import time
 from typing import Dict
 
 
-def profile_stages(n: int = 10) -> Dict[str, float]:
-    """ms/call per pipeline stage at the C=2 (256-lane) shape."""
+def profile_stages(n: int = 10, C: int = 2) -> Dict[str, float]:
+    """ms/call per pipeline stage at the C-chunk (C·128-lane) shape."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -29,7 +37,6 @@ def profile_stages(n: int = 10) -> Dict[str, float]:
 
     S = PK.PREP_S
     rng = np.random.default_rng(0)
-    C = 2
     pk = jnp.asarray(rng.integers(0, 2**16, (64, C * S)).astype(np.uint32))
     kmask = jnp.ones((1, C * S), jnp.int32)
     lo = jnp.ones((1, C * S), jnp.uint32)
@@ -43,9 +50,10 @@ def profile_stages(n: int = 10) -> Dict[str, float]:
     g1_aff, _fl = PK.prepare_kernel_call(pk, kmask, lo, hi, K=1)
     f = PK.miller_kernel_call(g1_aff, g2)
     prod = PK.product_chunks_kernel_call(f, lm)
+    fused = PK.miller_fold_kernel_call(g1_aff, g2, lm)
     ok = PK.finalize_kernel_call(prod)
     h = HK.hash_g2_kernel_call(ud)
-    jax.block_until_ready((ok, h))
+    jax.block_until_ready((ok, h, fused))
 
     stages = {
         "hash_to_curve": lambda: HK.hash_g2_kernel_call(ud),
@@ -53,6 +61,8 @@ def profile_stages(n: int = 10) -> Dict[str, float]:
             pk, kmask, lo, hi, K=1)[0],
         "miller": lambda: PK.miller_kernel_call(g1_aff, g2),
         "product_fold": lambda: PK.product_chunks_kernel_call(f, lm),
+        "miller_fold_fused": lambda: PK.miller_fold_kernel_call(
+            g1_aff, g2, lm),
         "final_exp": lambda: PK.finalize_kernel_call(prod),
     }
     out: Dict[str, float] = {}
@@ -62,5 +72,5 @@ def profile_stages(n: int = 10) -> Dict[str, float]:
         jax.block_until_ready(outs)
         out[f"stage_{name}_ms"] = round(
             (time.perf_counter() - t0) * 1e3 / n, 2)
-    out["stage_shape"] = "C=2 (256 lanes), K=1"
+    out["stage_shape"] = f"C={C} ({C * S} lanes), K=1"
     return out

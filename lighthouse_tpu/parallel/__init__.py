@@ -11,3 +11,5 @@ pairing partial products) riding XLA collectives.
 from .pipeline import ChunkStager, StagedExecutor  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .merkle_shard import sharded_merkle_root  # noqa: F401
+from .bls_shard import (  # noqa: F401
+    sharded_g1_sum, sharded_verify_signature_sets)

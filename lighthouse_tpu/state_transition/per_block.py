@@ -58,6 +58,23 @@ class BlockProcessingError(ValueError):
     pass
 
 
+# Wall-time decomposition of the most recent :func:`process_block` call
+# (plus the attestation sub-phases from the batched path) — the
+# profiling groundwork for the <150 ms per-block target (VERDICT r5
+# item 7).  Host perf_counter spans; the state-transition path is
+# synchronous numpy, so spans == cost.  Surfaced by bench.py as the
+# ``block_transition_ms`` phase split.
+LAST_BLOCK_TIMINGS: dict = {}
+
+
+def _phase(name: str, t0: float) -> float:
+    import time
+    t1 = time.perf_counter()
+    LAST_BLOCK_TIMINGS[name] = round(
+        LAST_BLOCK_TIMINGS.get(name, 0.0) + (t1 - t0) * 1e3, 3)
+    return t1
+
+
 class SignatureStrategy(enum.Enum):
     """``BlockSignatureStrategy`` (``per_block_processing.rs:49-58``)."""
     NO_VERIFICATION = "no_verification"
@@ -104,11 +121,15 @@ def process_block(state, signed_block, fork: ForkName, preset, spec, T,
                   verify_block_root: bytes | None = None,
                   payload_verifier=None) -> None:
     """Apply ``signed_block.message`` to ``state`` (already slot-advanced)."""
+    import time
+
     if pubkey_cache is None:
         pubkey_cache = sigs.PubkeyCache()
     acc = SigAccumulator(strategy)
     block = signed_block.message
 
+    LAST_BLOCK_TIMINGS.clear()
+    t0 = time.perf_counter()
     if strategy in (SignatureStrategy.VERIFY_INDIVIDUAL,
                     SignatureStrategy.VERIFY_BULK):
         acc.add(sigs.block_proposal_signature_set(
@@ -116,6 +137,7 @@ def process_block(state, signed_block, fork: ForkName, preset, spec, T,
             block_root=verify_block_root))
 
     process_block_header(state, block, preset, T)
+    t0 = _phase("header_ms", t0)
     if fork >= ForkName.BELLATRIX and is_execution_enabled(state, block.body):
         # Pre-merge-transition blocks carry the default payload and skip both
         # steps (``per_block_processing.rs`` is_execution_enabled gate).
@@ -123,15 +145,20 @@ def process_block(state, signed_block, fork: ForkName, preset, spec, T,
             process_withdrawals(state, block.body.execution_payload, preset, T)
         process_execution_payload(state, block.body, fork, preset, spec, T,
                                   payload_verifier)
+    t0 = _phase("payload_ms", t0)
     process_randao(state, block, preset, acc, pubkey_cache,
                    verify=strategy != SignatureStrategy.NO_VERIFICATION)
     process_eth1_data(state, block.body.eth1_data, preset)
+    t0 = _phase("randao_eth1_ms", t0)
     process_operations(state, block.body, fork, preset, spec, T, acc,
                        pubkey_cache)
+    t0 = _phase("operations_ms", t0)
     if fork >= ForkName.ALTAIR:
         process_sync_aggregate(state, block.body.sync_aggregate, preset, spec,
                                T, acc)
+    t0 = _phase("sync_aggregate_ms", t0)
     acc.finish()
+    _phase("signature_verify_ms", t0)
 
 
 def process_block_header(state, block, preset, T) -> None:
@@ -424,6 +451,8 @@ def process_attestations_batched(state, attestations, fork, preset, spec, T,
         return
     base = base_u64.astype(np.int64)
 
+    import time
+    t0 = time.perf_counter()
     idx_parts: list[np.ndarray] = []
     counts = np.empty(len(attestations), dtype=np.int64)
     flag_bits = np.empty(len(attestations), dtype=np.uint8)
@@ -444,6 +473,7 @@ def process_attestations_batched(state, attestations, fork, preset, spec, T,
         flag_bits[a] = sum(1 << f for f in flags)
         is_cur[a] = data.target.epoch == cur
 
+    t0 = _phase("atts_committee_resolution_ms", t0)
     idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
     seg = np.repeat(np.arange(len(attestations)), counts)
     flags_flat = np.repeat(flag_bits, counts)
@@ -475,6 +505,7 @@ def process_attestations_batched(state, attestations, fork, preset, spec, T,
         state.current_epoch_participation = cur_part
     if not is_cur.all():
         state.previous_epoch_participation = prev_part
+    t0 = _phase("atts_participation_update_ms", t0)
 
     proposer_reward_denominator = safe_div(
         safe_mul(safe_sub(WEIGHT_DENOMINATOR, PROPOSER_WEIGHT),
@@ -484,6 +515,7 @@ def process_attestations_batched(state, attestations, fork, preset, spec, T,
         for num in numerators)
     increase_balance(state, get_beacon_proposer_index(state, preset),
                      proposer_reward)
+    _phase("atts_proposer_reward_ms", t0)
 
 
 def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int,

@@ -1,0 +1,281 @@
+"""Differential suite for the mesh-sharded flagship BLS verify.
+
+``sharded_verify_signature_sets`` runs the whole ``verify_signature_sets``
+pipeline sets-axis data-parallel over the 8 virtual CPU devices
+(conftest) — per-chip aggregation/RLC/Miller/local fold, all-gathered
+Fq12 partials, ONE replicated final exponentiation — and must agree with
+the pure-python host oracle verdict-for-verdict.  Also pins the MXU
+band-product formulation (bit-exact vs the VPU path) and the shared-key
+collapsed fast path.
+
+Shape discipline: the quick tier drives exactly ONE compiled program
+(the 16-set/8-device flagship — valid/tampered/uneven all reuse it);
+even with the persistent compile cache warm
+(``scripts/validate_bls_shard.py --warmup``) each distinct sharded
+program costs ~2-3 min of per-process trace/lowering, so every
+additional Miller-shaped program (1-device degenerate mesh, the
+shared-key collapsed kernel, the fused-fold differential) lives under
+the ``slow`` marker; the shared-key path's host-side logic (group
+detection, aggregation fallback) keeps cheap quick coverage.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.fields import R
+from lighthouse_tpu.parallel.mesh import make_mesh
+from lighthouse_tpu.parallel.bls_shard import sharded_verify_signature_sets
+
+
+def _mk_sets(n, kps, tag=b"shard-smoke", key0=0x3000):
+    sk_ints = [key0 + 5 * i for i in range(n * kps)]
+    sks = [bls.SecretKey(v) for v in sk_ints]
+    pks = [k.public_key() for k in sks]
+    sets = []
+    for i in range(n):
+        lo, hi = i * kps, (i + 1) * kps
+        m = tag + b"-%02d" % i
+        agg = bls.SecretKey(sum(sk_ints[lo:hi]) % R).sign(m)
+        sets.append(bls.SignatureSet(agg, list(pks[lo:hi]), m))
+    return sets
+
+
+def _tamper(sets, i, j):
+    """Set i keeps its signature but claims set j's signing keys."""
+    bad = list(sets)
+    bad[i] = bls.SignatureSet(sets[i].signature, sets[j].signing_keys,
+                              sets[i].message)
+    return bad
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(jax.devices()[:8])
+
+
+def test_sharded_valid_batch_matches_host(mesh8):
+    sets = _mk_sets(16, 2)
+    assert bls._BACKENDS["python"].verify_signature_sets(sets) is True
+    assert sharded_verify_signature_sets(sets, mesh8) is True
+
+
+def test_sharded_tampered_set_rejected(mesh8):
+    bad = _tamper(_mk_sets(16, 2), 5, 6)
+    assert bls._BACKENDS["python"].verify_signature_sets(bad) is False
+    assert sharded_verify_signature_sets(bad, mesh8) is False
+
+
+def test_sharded_uneven_remainder(mesh8):
+    # 13 sets over 8 chips: pads to 16 (2/chip) with masked lanes — the
+    # same compiled program as the even tests.
+    sets = _mk_sets(13, 2)
+    assert sharded_verify_signature_sets(sets, mesh8) is True
+    assert sharded_verify_signature_sets(_tamper(sets, 12, 3), mesh8) is False
+
+
+@pytest.mark.slow
+def test_sharded_single_device_mesh():
+    # Degenerate 1-chip mesh: collectives over an axis of one.  Its own
+    # compiled program (~2.5 min/process even cache-warm) → slow tier;
+    # the quick tier's masking/padding coverage rides the 8-device
+    # program above.
+    mesh1 = make_mesh(jax.devices()[:1])
+    sets = _mk_sets(3, 1, tag=b"shard-d1", key0=0x5000)
+    assert sharded_verify_signature_sets(sets, mesh1) is True
+    assert sharded_verify_signature_sets(_tamper(sets, 2, 0), mesh1) is False
+
+
+def test_sharded_empty_and_missing_signature(mesh8):
+    assert sharded_verify_signature_sets([], mesh8) is False
+    sets = _mk_sets(16, 2)
+    sets[7] = bls.SignatureSet(None, sets[7].signing_keys, sets[7].message)
+    assert sharded_verify_signature_sets(sets, mesh8) is False
+
+
+# ---------------------------------------------------------------------------
+# Shared-key collapse (the fast_aggregate_verify winning path)
+# ---------------------------------------------------------------------------
+
+
+def test_shared_group_detection_and_host_aggregate():
+    """Quick host-side coverage of the collapsed path's plumbing: group
+    detection + the pure-python aggregation fallback (the device
+    differential is the slow test below + validate_bls_shard.py)."""
+    from lighthouse_tpu.crypto import curve as C
+    from lighthouse_tpu.crypto import tpu_backend as TB
+
+    pts = [bls.SecretKey(0x4242 + i).public_key().point for i in range(6)]
+    acc = None
+    for p in pts:
+        acc = C.g1_add(acc, p)
+    assert bls.aggregate_points(pts) == acc
+    assert bls.aggregate_points([pts[0], C.g1_neg(pts[0])]) is None
+
+    sig = bls.SecretKey(1).sign(b"m").point
+    shared = [(sig, [pts[0]], b"m%d" % i) for i in range(8)]
+    assert TB._shared_group_key(shared) == pts[0]
+    # Below the min batch, mixed keys, a missing signature, or a
+    # multi-key entry all refuse the collapse.
+    assert TB._shared_group_key(shared[:4]) is None
+    assert TB._shared_group_key(shared[:7] + [(sig, [pts[1]], b"x")]) is None
+    assert TB._shared_group_key(shared[:7] + [(None, [pts[0]], b"x")]) is None
+    assert TB._shared_group_key(
+        shared[:7] + [(sig, [pts[0], pts[1]], b"x")]) is None
+    # Dedup collapses identical >4-key lists to one aggregated key and
+    # records the aggregation time for the bench stage split.
+    entries = [(sig, pts, b"m%d" % i) for i in range(8)]
+    out, valid = TB._dedup_shared_keygroups(entries)
+    assert valid and all(len(e[1]) == 1 for e in out)
+    assert out[0][1][0] == acc
+    assert TB._shared_group_key(out) == acc
+
+
+@pytest.mark.slow
+def test_shared_key_collapse_matches_oracle(monkeypatch):
+    from lighthouse_tpu.crypto import tpu_backend as TB
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX", "0")
+    kps, n_msgs = 6, 8  # > 4 keys → dedup aggregates; 8 sets ≥ SHARED_MIN
+    sk_ints = [0x7000 + 3 * i for i in range(kps)]
+    pks = [bls.SecretKey(v).public_key() for v in sk_ints]
+    fsum = sum(sk_ints) % R
+    msgs = [b"sync-comm-%02d" % i for i in range(n_msgs)]
+    fsets = [bls.SignatureSet(bls.SecretKey(fsum).sign(m), list(pks), m)
+             for m in msgs]
+    tpu = bls._BACKENDS["tpu"]
+    monkeypatch.setattr(TB, "STAGE_TIMINGS", True)
+    assert tpu.verify_signature_sets(fsets) is True
+    assert TB.LAST_FAST_AGG_TIMINGS.get("path") == "xla_shared", \
+        "batch did not take the collapsed shared-key path"
+    assert bls._BACKENDS["python"].verify_signature_sets(fsets) is True
+    # One tampered signature sinks the whole collapsed batch.
+    bad = list(fsets)
+    bad[3] = bls.SignatureSet(fsets[4].signature, fsets[3].signing_keys,
+                              fsets[3].message)
+    assert tpu.verify_signature_sets(bad) is False
+    assert bls._BACKENDS["python"].verify_signature_sets(bad) is False
+    # A wrong-key batch must fail too (binding to P, not just to σ).
+    other = bls.SecretKey(0x9999).public_key()
+    bad2 = [bls.SignatureSet(s.signature, [other] * kps, s.message)
+            for s in fsets]
+    assert tpu.verify_signature_sets(bad2) is False
+
+
+# ---------------------------------------------------------------------------
+# MXU band-product formulation (bit-exact vs the VPU path)
+# ---------------------------------------------------------------------------
+
+
+def test_mxu_band_columns_bit_exact():
+    from lighthouse_tpu.crypto import limb_field as LF
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(0, 2**16, (37, LF.LIMBS)).astype(np.uint32))
+    b = jnp.asarray(rng.integers(0, 2**16, (37, LF.LIMBS)).astype(np.uint32))
+    for ncols in (LF.LIMBS, 2 * LF.LIMBS):
+        vpu = np.asarray(LF._band_columns(a, b, ncols))
+        mxu = np.asarray(LF._band_columns_mxu(a, b, ncols))
+        assert (vpu == mxu).all()
+
+
+def test_mxu_mont_mul_exact(monkeypatch):
+    from lighthouse_tpu.crypto import fields as F
+    from lighthouse_tpu.crypto import limb_field as LF
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MXU", "1")
+    monkeypatch.setattr(LF, "_MXU_FLAG", None)
+    assert LF.use_mxu()
+    rng = np.random.default_rng(1)
+    vals = [int(x) for x in rng.integers(1, 2**60, 8)] + [F.P - 1, 1]
+    try:
+        for x in vals:
+            got = LF.from_mont(np.asarray(LF.mont_mul(
+                jnp.asarray(LF.to_mont(x)), jnp.asarray(LF.to_mont(x + 7)))))
+            assert got == x * (x + 7) % F.P
+    finally:
+        monkeypatch.setattr(LF, "_MXU_FLAG", None)
+
+
+def test_mxu_k_band_bit_exact():
+    from lighthouse_tpu.crypto import limb_field as LF
+    from lighthouse_tpu.crypto import pairing_kernel as PK
+
+    PK._bind_consts(
+        jnp.asarray(PK.CONSTS_PLANES),
+        jnp.asarray(PK.X_BITS_FULL.reshape(-1, 1).astype(np.int32)),
+        jnp.asarray(PK.P_MINUS_2_BITS.reshape(-1, 1).astype(np.int32)),
+        jnp.asarray(PK.BAND_SEL_T))
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.integers(0, 2**16, (PK.LIMBS, 4)).astype(np.uint32))
+    b = jnp.asarray(rng.integers(0, 2**16, (PK.LIMBS, 4)).astype(np.uint32))
+    for ncols in (PK.LIMBS, 2 * PK.LIMBS):
+        assert (np.asarray(PK.k_band(a, b, ncols))
+                == np.asarray(PK.k_band_mxu(a, b, ncols))).all()
+
+
+def test_mxu_k_band_in_kernel_refs(monkeypatch):
+    """k_band_mxu traced INSIDE a pallas kernel, where the selection
+    matrix arrives as a memory Ref rather than an eager array — a raw
+    (unloaded) Ref fed to dot_general aborts the trace of every TPU
+    kernel, and only this interpret-mode drive can catch that on CPU."""
+    from jax.experimental import pallas as pl
+
+    from lighthouse_tpu.crypto import limb_field as LF
+    from lighthouse_tpu.crypto import pairing_kernel as PK
+
+    monkeypatch.setattr(LF, "_MXU_FLAG", True)
+    # The in-kernel _bind_consts writes traced Refs into the module
+    # global; give the trace its own dict so they can't leak out.
+    monkeypatch.setattr(PK, "_KC", dict(PK._KC))
+    M = 8
+    rng = np.random.default_rng(5)
+    a = rng.integers(0, 2**16, (PK.LIMBS, M)).astype(np.uint32)
+    b = rng.integers(0, 2**16, (PK.LIMBS, M)).astype(np.uint32)
+
+    def kern(cref, xref, pref, bandref, aref, bref, out26, out52):
+        PK._bind_consts(cref, xref, pref, bandref)
+        out26[...] = PK.k_band_mxu(aref[...], bref[...], PK.LIMBS)
+        out52[...] = PK.k_band_mxu(aref[...], bref[...], 2 * PK.LIMBS)
+
+    out26, out52 = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct((PK.LIMBS, M), jnp.uint32),
+                   jax.ShapeDtypeStruct((2 * PK.LIMBS, M), jnp.uint32)],
+        interpret=True,
+    )(*PK._const_args(), jnp.asarray(a), jnp.asarray(b))
+    aj, bj = jnp.asarray(a), jnp.asarray(b)
+    assert (np.asarray(out26)
+            == np.asarray(PK.k_band(aj, bj, PK.LIMBS))).all()
+    assert (np.asarray(out52)
+            == np.asarray(PK.k_band(aj, bj, 2 * PK.LIMBS))).all()
+
+
+# ---------------------------------------------------------------------------
+# Fused Miller+fold kernel (new Miller batch shape → slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="pallas pairing kernels need a real TPU (Mosaic; "
+                           "CPU pallas_call is interpret-only in this jax)")
+def test_miller_fold_fused_matches_unfused():
+    """The fused kernel's output must be byte-identical to
+    miller_kernel_call + product_chunks_kernel_call on the same lanes
+    (identical op sequence, VMEM-resident intermediate)."""
+    from lighthouse_tpu.crypto import pairing_kernel as PK
+
+    rng = np.random.default_rng(3)
+    M = 2 * PK.LANE_BLOCK
+    g1 = jnp.asarray(rng.integers(0, 2**16, (64, M)).astype(np.uint32))
+    g2 = jnp.asarray(rng.integers(0, 2**16, (128, M)).astype(np.uint32))
+    mask = np.zeros((1, M), np.int32)
+    mask[0, :5] = 1
+    mask = jnp.asarray(mask)
+    f = PK.miller_kernel_call(g1, g2)
+    want = np.asarray(PK.product_chunks_kernel_call(f, mask))
+    got = np.asarray(PK.miller_fold_kernel_call(g1, g2, mask))
+    assert (got == want).all()
