@@ -32,6 +32,11 @@ Also measured (BASELINE rows 2-5 + latency tier):
   path); ``leaf_push_wait_ms``/``leaf_push_overlap_ms`` are the same
   split for the non-registry big-field leaf pushes
   (``merkle_levels_device``).
+- ``state_root_device_resident`` — the device-resident counterpart: one
+  ``materialize_state`` push makes HBM the source of truth, then warm
+  roots are timed clean and at 0.1% / 1% / 10% dirty fractions with
+  bytes-pushed-per-root (≈ 0 clean; ∝ dirty rows otherwise — the cold
+  row's 5+ s re-stage is eliminated from the warm path, not overlapped).
 - ``block_transition_ms`` / ``block_transition_atts_per_s`` — Capella
   block with 128 attestations applied to a 2^14-validator mainnet state,
   per-phase (BASELINE row 3; `lcli/src/transition_blocks.rs:229`),
@@ -326,6 +331,83 @@ def _incremental_state_root_bench() -> dict:
         "leaf_push_builds": MK.LAST_PUSH_STATS.get("builds"),
         "state_root_incremental_ms": round(min(ts), 2),
     }
+
+
+def _device_resident_state_root_bench() -> dict:
+    """Device-resident BeaconState roots (ISSUE 6 tentpole): ONE column
+    push materializes HBM as the source of truth, then every warm root's
+    H2D is bounded by the dirty fraction — the ~5 s full-state re-stage
+    of the cold row above is eliminated from the warm path, not
+    overlapped.  Reports the materialize-once split, a zero-dirty warm
+    root (bytes pushed ≈ 0), and a 0.1% / 1% / 10% dirty-fraction sweep
+    with bytes-pushed-per-root."""
+    from lighthouse_tpu.ops.device_tree import (residency_snapshot,
+                                                reset_residency_stats)
+    from lighthouse_tpu.types.device_state import (LAST_MATERIALIZE_STATS,
+                                                   materialize_state)
+    from lighthouse_tpu.types.presets import MAINNET
+    from lighthouse_tpu.types.factory import spec_types
+    from lighthouse_tpu.types.chain_spec import ForkName
+    from lighthouse_tpu.types.validators import ValidatorRegistry
+
+    n = 1 << STATE_LOG2
+    rng = np.random.default_rng(3)
+    T = spec_types(MAINNET)
+    state = T.state_cls(ForkName.CAPELLA)()
+    reg = ValidatorRegistry(n)
+    reg._n = n
+    reg.init_columns(
+        pubkey=rng.integers(0, 256, (n, 48), dtype=np.uint8),
+        withdrawal_credentials=rng.integers(0, 256, (n, 32), dtype=np.uint8),
+        effective_balance=np.full(n, 32 * 10**9, dtype=np.uint64))
+    state.validators = reg
+    state.balances = np.full(n, 32 * 10**9, dtype=np.uint64)
+    state.previous_epoch_participation = np.zeros(n, dtype=np.uint8)
+    state.current_epoch_participation = np.zeros(n, dtype=np.uint8)
+    state.inactivity_scores = np.zeros(n, dtype=np.uint64)
+
+    reset_residency_stats()
+    materialize_state(state)  # the ONE full-width push of this lineage
+    out = {
+        "state_root_device_materialize_ms":
+            LAST_MATERIALIZE_STATS.get("materialize_ms"),
+        "state_root_device_materialize_bytes":
+            LAST_MATERIALIZE_STATS.get("bytes_pushed"),
+    }
+
+    def timed_root() -> tuple:
+        before = residency_snapshot()
+        t0 = time.perf_counter()
+        state.tree_hash_root()
+        ms = (time.perf_counter() - t0) * 1e3
+        after = residency_snapshot()
+        return ms, after["bytes_pushed"] - before["bytes_pushed"]
+
+    # Zero-dirty warm root: nothing to scatter — the headline "bytes
+    # pushed per warm root ≈ 0 after materialization" number.
+    ms0, bytes0 = timed_root()
+    out["state_root_device_warm_clean_ms"] = round(ms0, 2)
+    out["state_root_device_warm_clean_bytes"] = int(bytes0)
+
+    salt = 1
+    for label, frac in (("0.1", 1000), ("1", 100), ("10", 10)):
+        k = max(n // frac, 1)
+        ts, pushed = [], []
+        for _ in range(RUNS):
+            idx = rng.choice(n, k, replace=False)
+            state.validators.wcol("effective_balance")[idx] -= np.uint64(salt)
+            state.balances[idx] = (
+                np.asarray(state.balances)[idx] - np.uint64(salt))
+            salt += 1
+            ms, nb = timed_root()
+            ts.append(ms)
+            pushed.append(nb)
+        out[f"state_root_device_warm_{label}pct_ms"] = round(min(ts), 2)
+        out[f"state_root_device_push_bytes_{label}pct"] = int(min(pushed))
+    stats = residency_snapshot()
+    out["state_root_device_ops"] = {
+        k: stats[k] for k in ("scatters", "rebuilds", "materializes")}
+    return out
 
 
 def _block_transition_bench() -> dict:
@@ -664,6 +746,8 @@ _ROWS = [
      True),
     ("state_root", _incremental_state_root_bench,
      "state_root_2e%d" % STATE_LOG2, True),
+    ("state_device", _device_resident_state_root_bench,
+     "state_root_device_resident", True),
     ("op_pool", _op_pool_bench, "op_pool_pack_100k", False),
     ("slasher", _slasher_bench, "slasher_span_update_1m", False),
     ("block", _block_transition_bench, "block_transition_128att", False),

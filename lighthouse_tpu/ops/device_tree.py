@@ -1,0 +1,277 @@
+"""Device-resident incremental Merkle trees — zero-push warm roots.
+
+The host :class:`~lighthouse_tpu.ops.tree_cache.IncrementalMerkleCache`
+stores every interior level in host numpy and either walks dirty paths with
+hashlib or re-pushes the whole leaf set for a device rebuild.  That design
+made the *cold* state root 9.2 s of which 5.1 s was one monolithic H2D push
+(``state_root_cold_push_ms``) — the state lived on host and was re-staged
+for every device pass.  Here the tree levels live in HBM as the source of
+truth (the MTU tree-unit shape, arXiv:2507.16793: the whole hash-tree
+reduction stays on the accelerator) and a warm root is
+
+    H2D:  k dirty leaf rows (+ their int32 indices)       — bytes ∝ dirty
+    one fused program: leaf scatter → per-level re-hash   — k·log n hashes
+    D2H:  32 bytes of root
+
+so the full-state push disappears from the warm path instead of merely
+being overlapped.  Donation follows the
+:class:`~lighthouse_tpu.parallel.pipeline.StagedExecutor` idiom: when a
+tree owns its buffers exclusively the update program donates them (true
+in-place HBM update); after :meth:`DeviceTree.share` (fork-choice
+state-cache clones, ``BeaconState.copy``) the next update runs undonated —
+XLA materialises fresh buffers for the mutator and the sibling keeps the
+old ones untouched: copy-on-write without duplicating HBM at clone time.
+
+Dirty-index batches are padded to power-of-two buckets so the number of
+compiled program shapes stays logarithmic in the update size; padding
+duplicates a real (index, row) pair, which is idempotent under both the
+scatter and the re-hash.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .merkle import _next_pow2
+
+# Byte accounting for the residency story (surfaced by bench.py as
+# ``state_root_device_resident``): every host→device transfer made on
+# behalf of device-resident state goes through note_push, every pull of a
+# lazily-materialised host view through note_pull.
+RESIDENCY_STATS: dict = {
+    "bytes_pushed": 0, "bytes_pulled": 0,
+    "scatters": 0, "rebuilds": 0, "materializes": 0,
+}
+
+
+def reset_residency_stats() -> None:
+    for k in RESIDENCY_STATS:
+        RESIDENCY_STATS[k] = 0
+
+
+def note_push(nbytes: int) -> None:
+    RESIDENCY_STATS["bytes_pushed"] += int(nbytes)
+
+
+def note_pull(nbytes: int) -> None:
+    RESIDENCY_STATS["bytes_pulled"] += int(nbytes)
+
+
+def residency_snapshot() -> dict:
+    return dict(RESIDENCY_STATS)
+
+
+def _donation_works() -> bool:
+    """Donate buffers only where XLA honors it (TPU); on CPU jax ignores
+    donation with a warning per call — the undonated program is identical
+    apart from the in-place aliasing."""
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def _bucket(k: int) -> int:
+    """Dirty-batch size bucket: power of two ≥ 8 bounds the number of
+    compiled shapes to ~log(max batch)."""
+    return max(_next_pow2(max(k, 1)), 8)
+
+
+def pad_bucket(idx: np.ndarray, rows: np.ndarray) -> tuple:
+    """Pad ``(k,)`` indices / ``(k, …)`` rows to the bucket size by
+    repeating the first entry — idempotent under scatter + re-hash."""
+    k = idx.shape[0]
+    b = _bucket(k)
+    if k == b:
+        return idx.astype(np.int32, copy=False), rows
+    pidx = np.empty(b, dtype=np.int32)
+    pidx[:k] = idx
+    pidx[k:] = idx[0]
+    prows = np.empty((b,) + rows.shape[1:], dtype=rows.dtype)
+    prows[:k] = rows
+    prows[k:] = rows[0]
+    return pidx, prows
+
+
+def scatter_propagate_body(levels, idx, rows):
+    """The fused warm-root body: scatter ``rows`` into ``levels[0]`` at
+    ``idx`` and re-hash exactly the touched ancestor path of every index
+    up every level.  Duplicate indices (bucket padding) recompute the same
+    parent with the same inputs — wasted lanes, never wrong bits.
+
+    Shared verbatim by the packed-column trees and the registry mirror
+    (which feeds record-mini-tree roots as ``rows``), so one compiled
+    artifact per (bucket, width) covers both.
+    """
+    from .sha256 import hash64
+
+    out = [levels[0].at[idx].set(rows)]
+    cur = idx
+    for lvl in range(1, len(levels)):
+        cur = cur >> 1
+        below = out[-1]
+        h = hash64(below[2 * cur], below[2 * cur + 1])
+        out.append(levels[lvl].at[cur].set(h))
+    return tuple(out)
+
+
+_scatter_jit = None
+_scatter_jit_donated = None
+
+
+def _get_scatter_jit(donate: bool):
+    global _scatter_jit, _scatter_jit_donated
+    import jax
+    if donate:
+        if _scatter_jit_donated is None:
+            _scatter_jit_donated = jax.jit(scatter_propagate_body,
+                                           donate_argnums=(0,))
+        return _scatter_jit_donated
+    if _scatter_jit is None:
+        _scatter_jit = jax.jit(scatter_propagate_body)
+    return _scatter_jit
+
+
+def _levels_body(leaves, *, use_kernel: bool):
+    """All levels over ``(w, 8)`` u32 leaves (w pow2) — the same body as
+    :func:`..ops.merkle_kernel._levels_body`, re-exported here so the
+    device-resident rebuild path has no import-order coupling with the
+    Pallas module's jit singletons."""
+    from .merkle_kernel import _levels_body as body
+    return body(leaves, use_kernel=use_kernel)
+
+
+_levels_jit = None
+
+
+def _get_levels_jit():
+    global _levels_jit
+    import jax
+    if _levels_jit is None:
+        _levels_jit = jax.jit(_levels_body, static_argnames=("use_kernel",))
+    return _levels_jit
+
+
+def _use_kernel() -> bool:
+    from .merkle_kernel import _use_pallas
+    return _use_pallas()
+
+
+class DeviceTree:
+    """One padded Merkle tree whose every level lives on the device.
+
+    ``levels[0]`` is the ``(w, 8)`` u32 leaf plane (w a power of two),
+    ``levels[-1]`` the ``(1, 8)`` subtree root.  Zero-cap folding up to the
+    SSZ limit and the length mixin stay host-side (≤ ~40 single hashes),
+    exactly like the host cache.
+    """
+
+    __slots__ = ("levels", "shared")
+
+    def __init__(self, levels, shared: bool = False):
+        self.levels = tuple(levels)
+        self.shared = shared
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_host_leaves(cls, leaves: np.ndarray) -> "DeviceTree":
+        """One-time materialization: push the full (w, 8) leaf plane and
+        reduce every level on-device.  The ONLY full-width push this tree
+        ever makes."""
+        import jax
+        leaves = np.ascontiguousarray(leaves, dtype=np.uint32)
+        assert leaves.shape[0] == _next_pow2(leaves.shape[0])
+        note_push(leaves.nbytes)
+        RESIDENCY_STATS["materializes"] += 1
+        dev = jax.device_put(leaves)
+        return cls(_get_levels_jit()(dev, use_kernel=_use_kernel()))
+
+    @classmethod
+    def from_device_leaves(cls, leaves) -> "DeviceTree":
+        """Rebuild from leaves already resident in HBM — zero push."""
+        RESIDENCY_STATS["rebuilds"] += 1
+        return cls(_get_levels_jit()(leaves, use_kernel=_use_kernel()))
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def width(self) -> int:
+        return self.levels[0].shape[0]
+
+    def root_words(self) -> np.ndarray:
+        return np.asarray(self.levels[-1])[0]
+
+    def pull_levels(self) -> list:
+        """Host copies of every level (de-materialization / oracle)."""
+        out = [np.asarray(lv) for lv in self.levels]
+        note_pull(sum(lv.nbytes for lv in out))
+        return out
+
+    # -- updates -------------------------------------------------------------
+
+    def scatter(self, idx: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Warm update: ``rows`` (k, 8) u32 replace leaves at ``idx``
+        (ascending, unique); returns the new subtree root words.  H2D is
+        the bucket-padded (idx, rows) pair only."""
+        if idx.size == 0:
+            return self.root_words()
+        import jax
+        pidx, prows = pad_bucket(np.asarray(idx),
+                                 np.ascontiguousarray(rows, dtype=np.uint32))
+        note_push(pidx.nbytes + prows.nbytes)
+        RESIDENCY_STATS["scatters"] += 1
+        jit = _get_scatter_jit(_donation_works() and not self.shared)
+        self.levels = jit(self.levels, jax.device_put(pidx),
+                          jax.device_put(prows))
+        self.shared = False  # the update produced buffers only we hold
+        return self.root_words()
+
+    def scatter_device(self, idx_dev, rows_dev) -> np.ndarray:
+        """Scatter with (idx, rows) already device-resident (registry
+        mirror path) — zero push here; the caller accounted its own."""
+        RESIDENCY_STATS["scatters"] += 1
+        jit = _get_scatter_jit(_donation_works() and not self.shared)
+        self.levels = jit(self.levels, idx_dev, rows_dev)
+        self.shared = False
+        return self.root_words()
+
+    def rebuild_device(self, leaves) -> np.ndarray:
+        """Replace every level from device-resident leaves (dirty fraction
+        past the walk/rebuild crossover, or width growth) — zero push."""
+        RESIDENCY_STATS["rebuilds"] += 1
+        self.levels = _get_levels_jit()(leaves, use_kernel=_use_kernel())
+        self.shared = False
+        return self.root_words()
+
+    # -- copy-on-write -------------------------------------------------------
+
+    def share(self) -> "DeviceTree":
+        """COW clone: both trees reference the same HBM until either
+        mutates (jax arrays are immutable; the next update simply skips
+        donation and lands in fresh buffers)."""
+        self.shared = True
+        return DeviceTree(self.levels, shared=True)
+
+
+def warmup_scatter(width: int, ks=(1, 8, 64), depth_only: bool = False) -> int:
+    """Pre-compile the dirty-propagation program for a ``width``-leaf tree
+    at the given dirty-batch bucket sizes (plus the full-levels rebuild
+    body) so a fresh node's first warm root is a compile-cache hit.
+    Returns the number of programs driven."""
+    import jax
+
+    w = _next_pow2(max(width, 1))
+    leaves = np.zeros((w, 8), dtype=np.uint32)
+    tree = DeviceTree.from_host_leaves(leaves)
+    n = 1 if depth_only else 0
+    done = set()
+    for k in ks:
+        b = _bucket(min(k, w))
+        if b in done or b > w:
+            continue
+        done.add(b)
+        idx = np.arange(b, dtype=np.int32) % w
+        rows = np.zeros((b, 8), dtype=np.uint32)
+        tree.scatter(np.unique(idx), rows[:np.unique(idx).shape[0]])
+        n += 1
+    jax.block_until_ready(tree.levels)
+    return n + 1

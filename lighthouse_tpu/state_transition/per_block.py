@@ -403,10 +403,13 @@ def process_attestation(state, attestation, fork, preset, spec, T, acc,
             proposer_reward_numerator,
             safe_mul(int(base[idx[fresh]].sum()), weight))
 
+    from ..types.device_state import store_column
     if data.target.epoch == cur:
-        state.current_epoch_participation = participation
+        store_column(state, "current_epoch_participation", participation,
+                     touched=np.unique(idx))
     else:
-        state.previous_epoch_participation = participation
+        store_column(state, "previous_epoch_participation", participation,
+                     touched=np.unique(idx))
 
     proposer_reward_denominator = safe_div(
         safe_mul(safe_sub(WEIGHT_DENOMINATOR, PROPOSER_WEIGHT),
@@ -501,10 +504,15 @@ def process_attestations_batched(state, attestations, fork, preset, spec, T,
 
     # Write back only the columns the block touched (the scalar path only
     # expands/reassigns the column of each attestation's target epoch).
+    # On a device-resident state the columnar update lands as a device
+    # scatter of exactly the attested indices instead of a full re-stage.
+    from ..types.device_state import store_column
     if is_cur.any():
-        state.current_epoch_participation = cur_part
+        store_column(state, "current_epoch_participation", cur_part,
+                     touched=np.unique(idx[is_cur_flat]))
     if not is_cur.all():
-        state.previous_epoch_participation = prev_part
+        store_column(state, "previous_epoch_participation", prev_part,
+                     touched=np.unique(idx[~is_cur_flat]))
     t0 = _phase("atts_participation_update_ms", t0)
 
     proposer_reward_denominator = safe_div(
@@ -680,7 +688,10 @@ def process_sync_aggregate(state, aggregate, preset, spec, T, acc) -> None:
     if safe:
         delta = (inc_cnt - dec_cnt) * participant_reward
         delta[proposer] += n_participants * proposer_reward
-        state.balances = (bal.astype(np.int64) + delta).astype(np.uint64)
+        from ..types.device_state import store_column
+        store_column(state, "balances",
+                     (bal.astype(np.int64) + delta).astype(np.uint64),
+                     touched=np.flatnonzero(delta != 0))
     else:
         for i in range(members.shape[0]):
             idx = int(members[i])

@@ -174,7 +174,9 @@ def process_inactivity_updates(state, preset, spec) -> None:
     if not is_in_inactivity_leak(state, preset):
         rec = np.minimum(np.uint64(spec.inactivity_score_recovery_rate), scores)
         scores = np.where(eligible, scores - rec, scores)
-    state.inactivity_scores = scores
+    from ..types.device_state import store_column
+    store_column(state, "inactivity_scores", scores,
+                 touched=np.flatnonzero(eligible))
 
 
 def _full_column(arr, n: int, dtype) -> np.ndarray:
@@ -258,7 +260,9 @@ def process_rewards_and_penalties(state, fork: ForkName, preset, spec,
     bal = _full_column(state.balances, n, np.uint64)
     bal = bal + rewards
     bal = np.where(bal >= penalties, bal - penalties, np.uint64(0))
-    state.balances = bal
+    from ..types.device_state import store_column
+    store_column(state, "balances", bal,
+                 touched=np.flatnonzero((rewards != 0) | (penalties != 0)))
 
 
 def process_registry_updates(state, preset, spec,
@@ -319,7 +323,10 @@ def process_slashings(state, fork: ForkName, preset) -> None:
     n = len(reg)
     bal = _full_column(state.balances, n, np.uint64)
     pen = np.where(mask, penalties, np.uint64(0))
-    state.balances = np.where(bal >= pen, bal - pen, np.uint64(0))
+    from ..types.device_state import store_column
+    store_column(state, "balances",
+                 np.where(bal >= pen, bal - pen, np.uint64(0)),
+                 touched=np.flatnonzero(mask))
 
 
 def process_eth1_data_reset(state, preset) -> None:
@@ -530,7 +537,9 @@ def _fused_inactivity_and_rewards(state, fork: ForkName, preset, spec,
         rec = np.minimum(np.uint64(spec.inactivity_score_recovery_rate),
                          scores)
         scores = np.where(ctx.eligible, scores - rec, scores)
-    state.inactivity_scores = scores
+    from ..types.device_state import store_column
+    store_column(state, "inactivity_scores", scores,
+                 touched=np.flatnonzero(ctx.eligible))
     timings["inactivity_ms"] = (time.perf_counter() - t0) * 1e3
 
     t0 = time.perf_counter()
@@ -565,7 +574,8 @@ def _fused_inactivity_and_rewards(state, fork: ForkName, preset, spec,
     bal = _full_column(state.balances, n, np.uint64)
     bal = bal + rewards
     bal = np.where(bal >= penalties, bal - penalties, np.uint64(0))
-    state.balances = bal
+    store_column(state, "balances", bal,
+                 touched=np.flatnonzero((rewards != 0) | (penalties != 0)))
     timings["rewards_ms"] = (time.perf_counter() - t0) * 1e3
 
 

@@ -142,10 +142,14 @@ def fused_sweep(state, fork, preset, spec, ctx, summary, in_leak: bool,
                 "device epoch sweep failed; falling back to numpy",
                 exc_info=True)
         return False
-    state.inactivity_scores = np.asarray(scores, dtype=np.uint64)
+    # summary columns are consumed by host passes either way; the state
+    # columns are ADOPTED on a device-resident state (the jax outputs
+    # become the columns — no pull, the next root re-reduces in HBM).
+    from ..types.device_state import store_column
     summary.rewards = np.asarray(rewards, dtype=np.uint64)
     summary.penalties = np.asarray(penalties, dtype=np.uint64)
-    state.balances = np.asarray(balances, dtype=np.uint64)
+    store_column(state, "inactivity_scores", scores)
+    store_column(state, "balances", balances)
     ms = (time.perf_counter() - t0) * 1e3
     timings["inactivity_ms"] = 0.0
     timings["rewards_ms"] = ms

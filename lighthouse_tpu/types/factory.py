@@ -44,8 +44,11 @@ from .columns import (
     RootsList,
     RootsVector,
 )
+from .device_state import DEVICE_COLUMN_FIELDS as _DEVICE_COLUMN_FIELDS_T
 from .presets import Preset
 from .validators import Validator, ValidatorRegistryList
+
+_DEVICE_COLUMN_FIELDS = frozenset(_DEVICE_COLUMN_FIELDS_T)
 
 
 class SpecTypes:
@@ -383,7 +386,28 @@ class SpecTypes:
             ``types/src/beacon_state/tree_hash_cache.rs:332``): instances
             carry a :class:`~lighthouse_tpu.types.state_cache.StateHashCache`
             that makes repeated ``tree_hash_root()`` calls O(changes·log n);
-            ``copy()`` clones it like the reference's state clone."""
+            ``copy()`` clones it like the reference's state clone.
+
+            Once a state is device-resident
+            (:func:`~lighthouse_tpu.types.device_state.materialize_state`),
+            wholesale column assignment (``state.balances = new``) is routed
+            INTO the existing :class:`~lighthouse_tpu.types.device_state.
+            DeviceColumn` instead of replacing it, so residency and dirty
+            tracking survive every legacy write path; a jax-array RHS is
+            adopted without a pull (the jitted epoch sweep's outputs stay
+            in HBM)."""
+
+            def __setattr__(self, name, value):
+                if name in _DEVICE_COLUMN_FIELDS:
+                    from .device_state import DeviceColumn
+                    cur = self.__dict__.get(name)
+                    if isinstance(cur, DeviceColumn) and cur is not value:
+                        if isinstance(value, DeviceColumn):
+                            object.__setattr__(self, name, value)
+                        else:
+                            cur.assign(value)
+                        return
+                object.__setattr__(self, name, value)
 
             def tree_hash_root(self) -> bytes:
                 from .state_cache import StateHashCache
@@ -397,6 +421,8 @@ class SpecTypes:
                 thc = self.__dict__.get("_thc")
                 if thc is not None:
                     out.__dict__["_thc"] = thc.copy()
+                if self.__dict__.get("_device_resident"):
+                    out.__dict__["_device_resident"] = True
                 return out
 
             genesis_time: uint64

@@ -30,7 +30,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops.merkle import merkleize_host
-from ..ops.tree_cache import HASH_COUNT, IncrementalMerkleCache
+from ..ops.tree_cache import (HASH_COUNT, IncrementalMerkleCache,
+                              REBUILD_FRACTION)
 
 
 # Cold builds at/above this many records run on the attached TPU in one
@@ -97,23 +98,13 @@ class RegistryCache:
         # On pull failure leave tree.levels unset: the next root() sees a
         # cold tree and rebuilds (correctness never depends on the cache).
 
-    # -- the per-root entry point -------------------------------------------
+    # -- device-resident mode ------------------------------------------------
 
-    def root(self, reg, limit: int) -> bytes:
-        n = len(reg)
-        if self.tree is None:
-            self.tree = IncrementalMerkleCache(limit, mixin_length=True)
-        if self._pending is not None:
-            self._finish_pending()
-        from ..ops.merkle import _next_pow2
-        cold = (self.stored is None or self.count > n
-                or self.tree.levels is None
-                or self.tree.levels[0].shape[0] != _next_pow2(max(n, 1)))
-        if cold:
-            if n >= DEVICE_COLD_MIN and _tpu_attached():
-                return self._cold_device(reg, n)
-            return self._cold_host(reg, n)
-
+    def _diff_dirty(self, reg, n: int) -> np.ndarray:
+        """Consume the registry's dirty marks into exact record indices
+        (the shared walk of the host and device-resident warm paths):
+        marked columns diff against the stored copies with one vectorized
+        compare, grown rows are dirty by construction."""
         old_n = self.count
         dirty = np.zeros(n, dtype=bool)
         dirty[old_n:] = True
@@ -128,23 +119,87 @@ class RegistryCache:
         for r in reg._dirty_rows:
             if r < n:
                 dirty[r] = True
+        reg._dirty_cols.clear()
+        reg._dirty_rows.clear()
+        return np.nonzero(dirty)[0]
+
+    def _update_stored(self, reg, idx: np.ndarray, n: int) -> None:
+        for cname in reg._COLUMNS:
+            col = getattr(reg, cname)
+            st = self.stored[cname]
+            if st.shape[0] != n:  # grew (any padded width)
+                grown = np.zeros((n,) + st.shape[1:], dtype=st.dtype)
+                grown[:min(self.count, n)] = st[:min(self.count, n)]
+                st = grown
+                self.stored[cname] = st
+            if idx.size:
+                st[idx] = col[idx]
+
+    def _root_device(self, reg, n: int) -> bytes:
+        """Device-resident root: the mirror's HBM columns + record-root
+        tree are the hashing source of truth.  Cold = the ONE-TIME
+        materialization; warm = dirty records land as one fused scatter
+        dispatch (k raw rows up, 32 bytes down); past the rebuild
+        crossover the whole tree re-reduces from HBM with zero push."""
+        from ..ops.merkle import _next_pow2
+        from .validators import DeviceRegistryMirror
+
+        mirror = getattr(reg, "_dev_mirror", None)
+        w = _next_pow2(max(n, 1))
+        if self.stored is None or self.count > n or mirror is None:
+            self._snapshot(reg, n)
+            mirror = DeviceRegistryMirror.materialize(reg)
+            reg._dev_mirror = mirror
+            return self._fold(mirror.tree.root_words(),
+                              len(mirror.tree.levels) - 1, n)
+        idx = self._diff_dirty(reg, n)
+        self._update_stored(reg, idx, n)
+        self.count = n
+        grew = mirror.ensure_width(w)
+        if idx.size == 0 and not grew:
+            root = mirror.tree.root_words()
+        elif grew or idx.size > w // REBUILD_FRACTION:
+            if idx.size:
+                mirror.scatter_cols(reg, idx)
+            root = mirror.rebuild(n)
+        else:
+            root = mirror.scatter_records(reg, idx)
+        return self._fold(root, len(mirror.tree.levels) - 1, n)
+
+    # -- the per-root entry point -------------------------------------------
+
+    def root(self, reg, limit: int, device: bool = False) -> bytes:
+        n = len(reg)
+        if self.tree is None:
+            self.tree = IncrementalMerkleCache(limit, mixin_length=True)
+        if self._pending is not None:
+            self._finish_pending()
+        if device:
+            return self._root_device(reg, n)
+        if getattr(reg, "_dev_mirror", None) is not None:
+            # Knob flipped off mid-life: this host root consumes the dirty
+            # marks the mirror would need, so residency ends HERE — a later
+            # device root re-materializes instead of serving a stale tree.
+            # Any host levels predate the device era (device roots update
+            # only stored + HBM), so they must be rebuilt, not patched.
+            reg._dev_mirror = None
+            self.tree.levels = None
+        from ..ops.merkle import _next_pow2
+        cold = (self.stored is None or self.count > n
+                or self.tree.levels is None
+                or self.tree.levels[0].shape[0] != _next_pow2(max(n, 1)))
+        if cold:
+            if n >= DEVICE_COLD_MIN and _tpu_attached():
+                return self._cold_device(reg, n)
+            return self._cold_host(reg, n)
+
         # Marks are consumed: wcol views are only valid until the next
         # root (every in-tree caller writes immediately; the sticky
         # alternative re-diffed 130 MB of columns every slot at 2^20).
-        reg._dirty_cols.clear()
-        reg._dirty_rows.clear()
-        idx = np.nonzero(dirty)[0]
+        idx = self._diff_dirty(reg, n)
         if idx.size:
             roots = reg.record_roots_words(idx)
-            for cname in reg._COLUMNS:
-                col = getattr(reg, cname)
-                st = self.stored[cname]
-                if st.shape[0] != n:  # grew within the same padded width
-                    grown = np.zeros((n,) + st.shape[1:], dtype=st.dtype)
-                    grown[:old_n] = st
-                    st = grown
-                    self.stored[cname] = st
-                st[idx] = col[idx]
+            self._update_stored(reg, idx, n)
             self.count = n
             return self.tree.update_rows(idx, roots, n, length=n)
         self.count = n
@@ -163,7 +218,11 @@ class RegistryCache:
         return out
 
 
-_PACKED_PER_CHUNK = {8: 4, 1: 32}  # u64 → 4/chunk, u8 → 32/chunk
+# Shared with the device-resident twin (device_state.DevicePackedCache):
+# ONE packing implementation keeps the host-oracle and device roots
+# bit-identical by construction.
+from .device_state import _PER_CHUNK as _PACKED_PER_CHUNK  # noqa: E402
+from .device_state import pack_chunk_rows  # noqa: E402
 
 
 class _PackedSourceCache:
@@ -178,14 +237,7 @@ class _PackedSourceCache:
                                            mixin_length=mixin_length)
         self.src: np.ndarray | None = None
 
-    @staticmethod
-    def _pack_chunks(vals: np.ndarray) -> np.ndarray:
-        """(k, per) source values → (k, 8) big-endian chunk words (SSZ
-        little-endian packing inside each 32-byte chunk)."""
-        le = np.ascontiguousarray(
-            vals.astype(vals.dtype.newbyteorder("<"), copy=False))
-        return np.frombuffer(le.tobytes(), dtype=">u4").astype(
-            np.uint32).reshape(vals.shape[0], 8)
+    _pack_chunks = staticmethod(pack_chunk_rows)
 
     def root(self, arr: np.ndarray) -> bytes:
         per = _PACKED_PER_CHUNK[arr.dtype.itemsize]
@@ -224,16 +276,45 @@ class StateHashCache:
     def __init__(self):
         self.fields: dict[str, IncrementalMerkleCache] = {}
         self.packed: dict[str, _PackedSourceCache] = {}
+        self.device_packed: dict = {}  # fname → DevicePackedCache
         self.registry = RegistryCache()
         self.small: dict[str, tuple[bytes, bytes]] = {}  # fname → (enc, root)
 
+    @staticmethod
+    def _packed_limits(ftype) -> tuple[int, bool]:
+        """(limit_chunks, mixin_length) of a packed uint field without
+        needing a value (the DeviceColumn path never round-trips one)."""
+        per = 32 // np.dtype(ftype.DTYPE).itemsize
+        return (max((ftype.BOUND + per - 1) // per, 1),
+                not ftype.is_fixed_size())
+
     def root(self, state) -> bytes:
+        from .device_state import (DeviceColumn, DevicePackedCache,
+                                   wants_device_state, wrap_state_column)
+        use_dev = wants_device_state(state)
         leaves = []
         for fname, ftype in type(state).FIELDS.items():
             v = getattr(state, fname)
+            is_packed = (getattr(ftype, "DTYPE", None) is not None
+                         and np.dtype(ftype.DTYPE).itemsize
+                         in _PACKED_PER_CHUNK
+                         and (isinstance(v, DeviceColumn)
+                              or (isinstance(v, np.ndarray)
+                                  and v.ndim == 1)))
             if fname == "validators":
-                leaves.append(self.registry.root(v, ftype.LIMIT))
-            elif getattr(ftype, "DTYPE", None) is not None                     and isinstance(v, np.ndarray) and v.ndim == 1                     and v.dtype.itemsize in _PACKED_PER_CHUNK:
+                leaves.append(self.registry.root(v, ftype.LIMIT,
+                                                 device=use_dev))
+            elif is_packed and use_dev:
+                col = wrap_state_column(state, fname)
+                cache = self.device_packed.get(fname)
+                if cache is None:
+                    limit_chunks, mixin = self._packed_limits(ftype)
+                    cache = DevicePackedCache(limit_chunks, mixin)
+                    self.device_packed[fname] = cache
+                leaves.append(cache.root(col))
+            elif is_packed:
+                if isinstance(v, DeviceColumn):  # knob flipped off mid-life
+                    v = v.host()
                 cache = self.packed.get(fname)
                 if cache is None:
                     _w, limit_chunks, length = ftype.leaf_words(v)
@@ -265,6 +346,8 @@ class StateHashCache:
         out = StateHashCache.__new__(StateHashCache)
         out.fields = {k: c.copy() for k, c in self.fields.items()}
         out.packed = {k: c.copy() for k, c in self.packed.items()}
+        out.device_packed = {k: c.copy()
+                             for k, c in self.device_packed.items()}
         out.registry = self.registry.copy()
         out.small = dict(self.small)
         return out

@@ -113,6 +113,10 @@ class ValidatorRegistry:
         # in-place pubkey overwrite — invalidates.
         self._pk_index: dict | None = None
         self._pk_index_n = 0
+        # Device mirror (HBM-resident raw columns + record-root tree),
+        # attached by the device-resident hash cache; COW-shared across
+        # copy().  None until materialized.
+        self._dev_mirror = None
 
     _COLUMNS = ("pubkey", "withdrawal_credentials", "effective_balance",
                 "slashed", "activation_eligibility_epoch", "activation_epoch",
@@ -247,6 +251,11 @@ class ValidatorRegistry:
         out._dirty_rows = set(self._dirty_rows)
         out._pk_index = self._pk_index  # shared; forked on extension
         out._pk_index_n = self._pk_index_n
+        # COW: the clone shares every device buffer; the first mutation of
+        # either lineage lands in fresh buffers (undonated update program),
+        # so cloning duplicates no HBM and forces no pull.
+        out._dev_mirror = (None if self._dev_mirror is None
+                           else self._dev_mirror.share())
         return out
 
     def __eq__(self, other):
@@ -726,6 +735,225 @@ def registry_root_device(cols: dict, count: int, limit: int) -> bytes:
         root = _registry_root_fused(cols, depth=depth,
                                     chunk_log2=CHUNK_LOG2, use_kernel=False)
     return mix_in_length_host(words_to_bytes(np.asarray(root)), count)
+
+
+# ---------------------------------------------------------------------------
+# Device-resident registry mirror: HBM columns + record-root tree as the
+# hashing source of truth
+# ---------------------------------------------------------------------------
+#
+# ``registry_cold_device`` (above) pushes the raw columns for EVERY cold
+# root and pulls the interior levels back to host — the 5.1 s
+# ``state_root_cold_push_ms`` of BENCH_LATEST.  The mirror makes that push a
+# ONE-TIME materialization: the raw columns and every tree level stay in
+# HBM, ``wcol``/``set``/``append`` dirty marks become per-root record
+# scatters (k raw rows up, 32 bytes down), and the rebuild crossover
+# (dirty > width/8) re-reduces from the HBM-resident columns with zero
+# push.  ``share()`` gives copy-on-write clones for the fork-choice state
+# cache: buffers are shared until either lineage mutates (the update
+# program runs undonated and lands in fresh buffers).
+
+def _registry_raw_rows(reg: "ValidatorRegistry", idx: np.ndarray) -> dict:
+    """Raw-form marshalling of ``idx`` records (same column encodings as
+    :func:`_registry_raw_columns`, k rows instead of the full width)."""
+    rows = {
+        "pubkey": bytes_col_to_words(reg._pubkey[idx]),
+        "withdrawal_credentials": bytes_col_to_words(
+            reg._withdrawal_credentials[idx]),
+        "slashed": reg._slashed[idx].astype(np.uint8),
+    }
+    for f in ("effective_balance",) + _EPOCH_FIELDS:
+        rows[f] = np.ascontiguousarray(
+            getattr(reg, "_" + f)[idx]).view(np.uint32).reshape(-1, 2)
+    return rows
+
+
+def _pad_rows_bucket(idx: np.ndarray, rows: dict) -> tuple:
+    """Bucket-pad a record scatter — :func:`..ops.device_tree.pad_bucket`
+    applied per raw column (duplicating the first (index, raw row) pair is
+    idempotent: it scatters the same record and re-hashes the same path)."""
+    from ..ops.device_tree import pad_bucket
+    pidx = idx.astype(np.int32, copy=False)
+    out = {}
+    for name, arr in rows.items():
+        pidx, out[name] = pad_bucket(idx, arr)
+    return pidx, out
+
+
+def _mirror_scatter_body(levels, cols, idx, rows):
+    """The fused warm-root program: scatter the raw rows into the HBM
+    columns, re-hash exactly those records' 8-leaf mini-trees, and
+    propagate their ancestor paths through the record-root tree — leaf
+    re-hash → level propagation as ONE jitted dispatch."""
+    from ..ops.device_tree import scatter_propagate_body
+    new_cols = {k: cols[k].at[idx].set(rows[k]) for k in cols}
+    rec = _record_roots_body(rows, use_kernel=False)  # k records: XLA h64
+    return new_cols, scatter_propagate_body(levels, idx, rec)
+
+
+def _mirror_rebuild_body(cols, n_arr, *, use_kernel: bool):
+    """Full re-reduction from the HBM-resident columns (dirty fraction
+    past the walk/rebuild crossover, or width growth) — zero push.  Rows
+    at or beyond the dynamic record count ``n_arr`` are masked to zero
+    CHUNKS (SSZ list padding), so one compiled artifact per width serves
+    every count."""
+    import jax.numpy as jnp
+    rec = _record_roots_body(cols, use_kernel=use_kernel)
+    w = rec.shape[0]
+    keep = (jnp.arange(w, dtype=jnp.uint32) < n_arr)[:, None]
+    rec = jnp.where(keep, rec, jnp.zeros_like(rec))
+    h64 = _h64_device(use_kernel)
+    levels = [rec]
+    cur = rec
+    while cur.shape[0] > 1:
+        cur = h64(cur[0::2], cur[1::2])
+        levels.append(cur)
+    return tuple(levels)
+
+
+_mirror_scatter_jits: dict = {}
+_mirror_rebuild_jit = None
+
+
+def _get_mirror_scatter_jit(donate: bool):
+    import jax
+    jit = _mirror_scatter_jits.get(donate)
+    if jit is None:
+        jit = (jax.jit(_mirror_scatter_body, donate_argnums=(0, 1))
+               if donate else jax.jit(_mirror_scatter_body))
+        _mirror_scatter_jits[donate] = jit
+    return jit
+
+
+def _get_mirror_rebuild_jit():
+    global _mirror_rebuild_jit
+    import jax
+    if _mirror_rebuild_jit is None:
+        _mirror_rebuild_jit = jax.jit(_mirror_rebuild_body,
+                                      static_argnames=("use_kernel",))
+    return _mirror_rebuild_jit
+
+
+class DeviceRegistryMirror:
+    """HBM-resident raw columns + record-root tree for one registry
+    lineage (COW across :meth:`ValidatorRegistry.copy`)."""
+
+    __slots__ = ("cols", "tree", "shared")
+
+    def __init__(self, cols: dict, tree, shared: bool = False):
+        self.cols = cols
+        self.tree = tree
+        self.shared = shared
+
+    @property
+    def width(self) -> int:
+        return self.cols["slashed"].shape[0]
+
+    @classmethod
+    def materialize(cls, reg: "ValidatorRegistry") -> "DeviceRegistryMirror":
+        """One-time column push (chunk-staged for big registries, like the
+        cold build) + in-HBM level reduction.  This is the LAST full-width
+        push this lineage ever makes."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops.device_tree import (DeviceTree, RESIDENCY_STATS,
+                                       note_push)
+        from ..ops.merkle import _next_pow2
+        from ..ops.merkle_kernel import _use_pallas
+
+        n = reg._n
+        w = _next_pow2(max(n, 1))
+        host = _registry_raw_columns(reg, w)
+        note_push(sum(v.nbytes for v in host.values()))
+        RESIDENCY_STATS["materializes"] += 1
+        chunk = _reg_chunk_rows()
+        if chunk > 0 and w > chunk and w % chunk == 0:
+            from ..parallel.pipeline import ChunkStager
+            chunks = [{k: v[b:b + chunk] for k, v in host.items()}
+                      for b in range(0, w, chunk)]
+            parts = list(ChunkStager(chunks))
+            cols = {k: jnp.concatenate([p[k] for p in parts], axis=0)
+                    for k in host}
+        else:
+            cols = {k: jax.device_put(v) for k, v in host.items()}
+        levels = _get_mirror_rebuild_jit()(
+            cols, np.uint32(n), use_kernel=_use_pallas())
+        from ..ops.tree_cache import HASH_COUNT
+        HASH_COUNT[0] += 8 * w + (w - 1)
+        return cls(cols, DeviceTree(levels), False)
+
+    def scatter_records(self, reg: "ValidatorRegistry",
+                        idx: np.ndarray) -> np.ndarray:
+        """Land ``idx`` dirty records as one fused device dispatch; returns
+        the new subtree root words.  H2D = the bucket-padded raw rows."""
+        import jax
+        from ..ops.device_tree import (RESIDENCY_STATS, _donation_works,
+                                       note_push)
+        from ..ops.tree_cache import HASH_COUNT
+
+        pidx, rows = _pad_rows_bucket(np.asarray(idx),
+                                      _registry_raw_rows(reg, idx))
+        note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
+        RESIDENCY_STATS["scatters"] += 1
+        HASH_COUNT[0] += pidx.shape[0] * (8 + len(self.tree.levels) - 1)
+        jit = _get_mirror_scatter_jit(
+            _donation_works() and not self.shared and not self.tree.shared)
+        self.cols, self.tree.levels = jit(
+            self.tree.levels, self.cols, jax.device_put(pidx),
+            {k: jax.device_put(v) for k, v in rows.items()})
+        self.shared = False
+        self.tree.shared = False
+        return self.tree.root_words()
+
+    def scatter_cols(self, reg: "ValidatorRegistry",
+                     idx: np.ndarray) -> None:
+        """Update only the HBM columns at ``idx`` (no tree propagation) —
+        the prelude to :meth:`rebuild` when the dirty fraction or a width
+        change makes path-walking the wrong tool."""
+        import jax
+        from ..ops.device_tree import note_push
+
+        pidx, rows = _pad_rows_bucket(np.asarray(idx),
+                                      _registry_raw_rows(reg, idx))
+        note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
+        idx_dev = jax.device_put(pidx)
+        for k in self.cols:
+            self.cols[k] = self.cols[k].at[idx_dev].set(
+                jax.device_put(rows[k]))
+        self.shared = False
+
+    def rebuild(self, n: int) -> np.ndarray:
+        """Re-reduce every level from the HBM columns — zero push."""
+        from ..ops.device_tree import RESIDENCY_STATS
+        from ..ops.merkle_kernel import _use_pallas
+        from ..ops.tree_cache import HASH_COUNT
+
+        RESIDENCY_STATS["rebuilds"] += 1
+        w = self.width
+        HASH_COUNT[0] += 8 * w + (w - 1)
+        self.tree.levels = _get_mirror_rebuild_jit()(
+            self.cols, np.uint32(n), use_kernel=_use_pallas())
+        self.tree.shared = False
+        return self.tree.root_words()
+
+    def ensure_width(self, new_w: int) -> bool:
+        """Grow the HBM columns to ``new_w`` rows (device-side zero pad —
+        pad rows are masked at rebuild, their values never hashed).
+        Returns True when the width changed (caller must rebuild)."""
+        import jax.numpy as jnp
+        w = self.width
+        if new_w <= w:
+            return False
+        for k, v in self.cols.items():
+            pad = jnp.zeros((new_w - w,) + v.shape[1:], dtype=v.dtype)
+            self.cols[k] = jnp.concatenate([v, pad], axis=0)
+        self.shared = False  # concat produced buffers only we hold
+        return True
+
+    def share(self) -> "DeviceRegistryMirror":
+        self.shared = True
+        return DeviceRegistryMirror(dict(self.cols), self.tree.share(),
+                                    shared=True)
 
 
 _registry_type_cache: dict[int, type] = {}
