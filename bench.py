@@ -128,6 +128,7 @@ def _bls_bench() -> dict:
 
     tpu = bls._BACKENDS["tpu"]
 
+    breaker_mark = _breaker_attribution("bls")
     t_setup = time.perf_counter()
     sk_ints = [0x10000 + 7 * i for i in range(N_SETS * KEYS_PER_SET)]
     sks = [bls.SecretKey(v) for v in sk_ints]
@@ -220,6 +221,7 @@ def _bls_bench() -> dict:
         "fast_aggregate_ms_per_set": round(fam_ms / 256, 3),
         "fast_aggregate_stage_split": fam_stages,
         "bls_setup_s": round(setup_s, 1),
+        **_breaker_attribution("bls", breaker_mark),
     }
     if pipeline_stats:
         out.update({
@@ -554,6 +556,61 @@ def _op_pool_bench() -> dict:
             "op_pool_packed": packed}
 
 
+def _breaker_attribution(prefix: str, before=None):
+    """Stage-attribution guard (ISSUE 7): record whether any resilience
+    circuit breaker was open — or tripped — while a row's device-stage
+    timings were taken.  A host-fallback window during the run would
+    silently skew device-stage numbers; the flag makes a skewed row
+    self-describing instead of quietly wrong."""
+    from lighthouse_tpu.beacon_chain import verification_service as V
+
+    state = (V.any_breaker_open(), V.total_breaker_trips())
+    if before is None:
+        return state
+    return {
+        f"{prefix}_breaker_open_during_run":
+            bool(before[0] or state[0] or state[1] > before[1]),
+        f"{prefix}_breaker_trips_total": state[1],
+    }
+
+
+def _stream_verify_bench() -> dict:
+    """Streaming verification service drill — the robustness row: a
+    2000 msg/s burst stream with 10% injected dispatch faults and one
+    sustained outage window, through the service's adaptive micro-batch
+    scheduler and resilience envelope (modeled fixed-cost dispatch —
+    this row measures the BATCHING/RESILIENCE policy; crypto throughput
+    is the bls rows' number).  `stream_zero_loss` is the headline: no
+    valid message lost despite the outage (host fallback carried the
+    stream, the breaker re-closed after recovery).  Pure host logic —
+    survives a dead backend (`--host-only`)."""
+    from lighthouse_tpu.testing.stream_drill import run_drill
+
+    out = run_drill(n_messages=256, rate_per_s=2000.0, burst_every=32,
+                    burst_size=16, fail_rate=0.10, outage=(6, 14),
+                    slo_ms=50.0, max_batch=32, backend="fake",
+                    realtime=True, dispatch_model_ms=(2.0, 0.05), seed=0)
+    env = out["envelope"]
+    return {
+        "stream_messages": out["messages"],
+        "stream_zero_loss": out["zero_loss"],
+        "stream_recovered": out["recovered"],
+        "stream_slo_ms": out["slo_ms"],
+        "stream_latency_p50_ms": out["latency_p50_ms"],
+        "stream_latency_p99_ms": out["latency_p99_ms"],
+        "stream_slo_violations": out["slo_violations"],
+        "stream_batch_size_hist": out["batch_size_hist"],
+        "stream_dispatches": out["dispatches"],
+        "stream_shed": out["shed"],
+        "stream_host_fallbacks": env["host_fallbacks"],
+        "stream_faults_injected":
+            out["injector"]["injected"].get("bls_dispatch", 0),
+        "stream_breaker": env["breaker"],
+        "stream_result_paths": out["result_paths"],
+        "stream_wall_s": out["wall_s"],
+    }
+
+
 def _stage_split_bench() -> dict:
     """VERDICT r4 #2: the measured per-stage decomposition of the fused
     pipeline (marshal/hash/prepare/Miller/fold/finalize) — at the r5
@@ -563,10 +620,12 @@ def _stage_split_bench() -> dict:
     final-exp tail amortizes 4× further."""
     from lighthouse_tpu.crypto.profiling import profile_stages
 
+    mark = _breaker_attribution("stage_split")
     out = profile_stages(C=2)
     wide = profile_stages(C=8)
     out.update({k.replace("stage_", "stage_c8_"): v
                 for k, v in wide.items() if k != "stage_shape"})
+    out.update(_breaker_attribution("stage_split", mark))
     return out
 
 
@@ -742,6 +801,7 @@ def _probe_backend(timeout_s: float) -> str | None:
 # needs_device=False survive a dead backend (`--host-only` fallback).
 _ROWS = [
     ("secure", _secure_channel_bench, "secure_channel", False),
+    ("stream", _stream_verify_bench, "stream_verify", False),
     ("registry", _registry_htr_bench, "registry_htr_2e%d" % REG_LOG2,
      True),
     ("state_root", _incremental_state_root_bench,

@@ -13,6 +13,11 @@ from .attestation_verification import (
     batch_verify_attestations,
 )
 from .data_availability import DataAvailabilityChecker, build_blob_sidecars
+from .verification_service import (
+    CircuitBreaker,
+    ResilienceEnvelope,
+    VerificationService,
+)
 from .errors import (
     AttestationError,
     BlobSidecarError,
@@ -36,4 +41,5 @@ __all__ = [
     "IncorrectProposer", "ProposalSignatureInvalid", "InvalidSignatures",
     "StateRootMismatch", "RepeatProposal", "BlobsUnavailable",
     "BlobSidecarError", "DataAvailabilityChecker", "build_blob_sidecars",
+    "VerificationService", "ResilienceEnvelope", "CircuitBreaker",
 ]

@@ -89,6 +89,18 @@ def _signature_set(chain, att, indices, state) -> bls.SignatureSet:
         chain.pubkey_cache, chain.preset)
 
 
+def _accept(chain, att, idx, committee) -> VerifiedAttestation:
+    """Record attesters (two-phase: only AFTER the signature verified)
+    and build the verified wrapper — the synchronous batch path.  The
+    streaming completion callback does NOT use this: it needs the
+    atomic observe-if-fresh form (register only when some attester is
+    new) to dedup concurrent duplicate copies."""
+    epoch = int(att.data.target.epoch)
+    for v in idx:
+        chain.observed_attesters.observe(epoch, int(v))
+    return VerifiedAttestation(att, idx, committee)
+
+
 def batch_verify_attestations(chain, attestations: List
                               ) -> List[Tuple[object, Optional[Exception]]]:
     """One batched signature verify for the window; individual fallback on
@@ -104,10 +116,7 @@ def batch_verify_attestations(chain, attestations: List
             results[i] = (None, e)
 
     def accept(i, att, idx, committee):
-        epoch = int(att.data.target.epoch)
-        for v in idx:  # record only on success (two-phase)
-            chain.observed_attesters.observe(epoch, int(v))
-        results[i] = (VerifiedAttestation(att, idx, committee), None)
+        results[i] = (_accept(chain, att, idx, committee), None)
 
     if staged:
         sets = [_signature_set(chain, att, idx, state)
@@ -124,3 +133,50 @@ def batch_verify_attestations(chain, attestations: List
                     results[i] = (None, AttestationSignatureInvalid(
                         f"attestation {i} signature invalid"))
     return results
+
+
+def stream_verify_attestations(chain, service, attestations: List,
+                               kind: str = "attestation") -> int:
+    """Gossip-path streaming verification: cheap checks run NOW (slot
+    window, known head, committee resolution, first-seen peek), the
+    signature set streams through the service's adaptive device buckets,
+    and an accepted attestation registers with the chain (fork choice +
+    op pool) from the completion callback.  A batch-verdict failure
+    splits per message inside the service, so the isolation guarantee of
+    :func:`batch_verify_attestations` is preserved.  Returns the number
+    of messages submitted (cheap-check rejects are dropped here, exactly
+    like the synchronous path drops them with an error)."""
+    submitted = 0
+    for att in attestations:
+        try:
+            indices, committee, state = _cheap_checks(chain, att)
+        except AttestationError:
+            continue
+        sset = _signature_set(chain, att, indices, state)
+
+        def on_result(ok: bool, path: str, att=att, idx=indices,
+                      committee=committee) -> None:
+            if not ok:
+                return
+            # First-seen dedup at COMPLETION, via the ATOMIC
+            # observe-if-fresh primitive: the streaming window is wider
+            # than one batch (mesh redundancy delivers duplicate copies
+            # within the SLO window, all passing the submit-time peek),
+            # and concurrent pump threads can finish two copies at once
+            # — a peek-then-observe pair here would let both register,
+            # inflating the op pool and re-firing fork choice.
+            # Attesters are still only recorded post-verify, so junk
+            # can't censor; the copy that loses the observe race finds
+            # no fresh attesters and drops, exactly like the
+            # synchronous path's PriorAttestationKnown.
+            epoch = int(att.data.target.epoch)
+            fresh = [v for v in idx
+                     if chain.observed_attesters.observe(epoch, int(v))]
+            if not fresh:
+                return
+            chain.register_verified_attestation(
+                VerifiedAttestation(att, idx, committee))
+
+        if service.submit(kind, [sset], on_result, meta=att):
+            submitted += 1
+    return submitted

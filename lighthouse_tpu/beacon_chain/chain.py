@@ -111,6 +111,7 @@ class BeaconChain:
         self.validator_monitor = None  # opt-in: set a ValidatorMonitor
         from .data_availability import DataAvailabilityChecker
         self.data_availability = DataAvailabilityChecker(preset, T)
+        self.verification_service = None  # streaming verify (network seam)
         self.genesis_block_root = genesis_block_root
         self.fork_choice = ForkChoice(
             preset, spec, genesis_root=genesis_block_root,
@@ -201,6 +202,7 @@ class BeaconChain:
         chain.validator_monitor = None
         from .data_availability import DataAvailabilityChecker
         chain.data_availability = DataAvailabilityChecker(preset, T)
+        chain.verification_service = None
         chain.genesis_block_root = genesis_root
         chain.genesis_state_root = genesis_state_root
         chain.fork_choice = fc
@@ -576,25 +578,84 @@ class BeaconChain:
 
     # -- attestations --------------------------------------------------------
 
+    def register_verified_attestation(self, verified) -> None:
+        """Post-verification import — fork choice + op pool + event
+        stream.  The tail of :meth:`process_attestation_batch`, shared
+        with the streaming verification service's completion callback."""
+        try:
+            self.fork_choice.on_attestation(_Indexed(
+                verified.attestation.data,
+                [int(i) for i in verified.indexed_indices]))
+        except Exception:
+            pass
+        self.op_pool.insert_attestation(verified.attestation,
+                                        verified.committee)
+        self.event_bus.publish("attestation", {
+            "slot": str(int(verified.attestation.data.slot)),
+            "index": str(int(verified.attestation.data.index))})
+
     def process_attestation_batch(self, attestations: List) -> List:
         """Gossip batch → one device verify → fork choice + op pool
-        (`attestation_verification/batch.rs` + `beacon_chain.rs:1858`)."""
+        (`attestation_verification/batch.rs` + `beacon_chain.rs:1858`).
+        Synchronous: the VC / HTTP-API submission path."""
         results = batch_verify_attestations(self, attestations)
         for verified, err in results:
-            if verified is None:
-                continue
-            try:
-                self.fork_choice.on_attestation(_Indexed(
-                    verified.attestation.data,
-                    [int(i) for i in verified.indexed_indices]))
-            except Exception:
-                pass
-            self.op_pool.insert_attestation(verified.attestation,
-                                            verified.committee)
-            self.event_bus.publish("attestation", {
-                "slot": str(int(verified.attestation.data.slot)),
-                "index": str(int(verified.attestation.data.index))})
+            if verified is not None:
+                self.register_verified_attestation(verified)
         return results
+
+    def stream_attestation_batch(self, attestations: List,
+                                 kind: str = "attestation"):
+        """Gossip-path entry: route the batch through the streaming
+        verification service (SLO-driven micro-batching + resilience
+        envelope); verified attestations register from the service's
+        callback.  Falls back to the synchronous path when no service is
+        attached.  ``kind`` is the shedding class — ``"aggregate"`` is
+        never shed, ``"attestation"`` (subnet singles) degrades first."""
+        svc = self.verification_service
+        if svc is None:
+            return self.process_attestation_batch(attestations)
+        from .attestation_verification import stream_verify_attestations
+        stream_verify_attestations(self, svc, attestations, kind=kind)
+        return None
+
+    def ensure_verification_service(self, **kw):
+        """Create (once) the chain's streaming verification service, hook
+        the data-availability checker's KZG batches through its resilient
+        path, and install the process-global BLS envelope.  Raises when
+        config kwargs arrive after the service exists — silently
+        returning the already-configured service would drop them (the
+        NetworkNode creates the service with defaults at construction;
+        configure via env knobs or before attaching the network)."""
+        if self.verification_service is not None:
+            if kw:
+                raise ValueError(
+                    "verification service already exists; config "
+                    f"kwargs would be ignored: {sorted(kw)}")
+            return self.verification_service
+        from .verification_service import (
+            VerificationService, install_global_envelope)
+        svc = VerificationService(**kw)
+        self.verification_service = svc
+        self.data_availability.verify_batch_fn = svc.verify_blob_batch
+        self._installed_global_envelope = install_global_envelope()
+        return self.verification_service
+
+    def release_verification_service(self) -> None:
+        """Teardown pair of :meth:`ensure_verification_service`: detach
+        the DA hook and drop this chain's refcount on the process-global
+        BLS envelope (the LAST release detaches the wrapper)."""
+        if self.verification_service is None:
+            return
+        from .verification_service import release_global_envelope
+        # Drain first: in-flight completion callbacks must not fire
+        # into a chain whose service is already detached.
+        self.verification_service.flush()
+        self.data_availability.verify_batch_fn = None
+        self.verification_service = None
+        if getattr(self, "_installed_global_envelope", False):
+            self._installed_global_envelope = False
+            release_global_envelope()
 
     # -- production ----------------------------------------------------------
 

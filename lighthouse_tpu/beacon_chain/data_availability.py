@@ -16,7 +16,8 @@ never materializes the width-sized G1 Lagrange table
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 from ..common.metrics import REGISTRY
 from .errors import BlobSidecarError, BlobsUnavailable
@@ -34,20 +35,50 @@ class DataAvailabilityChecker:
     # eviction is oldest-insertion-first.
     MAX_PENDING_ROOTS = 64
 
-    def __init__(self, preset, T, setup=None):
+    # Parked executed blocks (verified, awaiting blobs) expire: a block
+    # whose blobs never arrive must not hold its full post-state forever
+    # — blobs may simply never exist (an equivocating proposer withheld
+    # them), and the slot-window prune only advances with the clock.  An
+    # expired block is re-fetchable: dropping the parked entry costs a
+    # re-verification on retry, never the block.  Wall-clock TTL + count
+    # cap bound memory even on a stalled chain.
+    PARKED_BLOCK_TTL_S = 60.0
+    MAX_PARKED_BLOCKS = 16
+
+    def __init__(self, preset, T, setup=None, clock=time.monotonic):
         self.preset = preset
         self.T = T
         self._setup = setup
+        self._clock = clock
         self._lock = threading.Lock()
         # block_root → {index: verified BlobSidecar}
         self._pending: Dict[bytes, Dict[int, object]] = {}
-        # block_root → ExecutedBlock awaiting blobs (retries skip
-        # re-verification/re-execution — `pending_components` role).
-        self._pending_blocks: Dict[bytes, object] = {}
+        # block_root → (ExecutedBlock awaiting blobs, parked_at) (retries
+        # skip re-verification/re-execution — `pending_components` role).
+        self._pending_blocks: Dict[bytes, Tuple[object, float]] = {}
+        # Resilient-dispatch seam: when set (the chain's streaming
+        # verification service), batched KZG proof checks route through
+        # it — deadline/retry/circuit-breaker + host fallback.  Same
+        # signature as `kzg.verify_blob_kzg_proof_batch(b, c, p, setup)`.
+        self.verify_batch_fn = None
         self._verified = REGISTRY.counter(
             "blob_sidecars_verified_total", "Blob sidecars verified")
         self._rejected = REGISTRY.counter(
             "blob_sidecars_rejected_total", "Blob sidecars rejected")
+        self._expired = REGISTRY.counter(
+            "parked_blocks_expired_total",
+            "Parked executed blocks dropped by TTL/cap")
+
+    def _verify_batch(self, blobs, commitments, proofs) -> bool:
+        """One batched KZG verification, through the resilient service
+        when attached (raises ``kzg.KzgError`` on malformed data either
+        way)."""
+        if self.verify_batch_fn is not None:
+            return self.verify_batch_fn(blobs, commitments, proofs,
+                                        self.setup)
+        from .. import kzg as KZ
+        return KZ.verify_blob_kzg_proof_batch(blobs, commitments, proofs,
+                                              self.setup)
 
     @property
     def setup(self):
@@ -83,9 +114,9 @@ class DataAvailabilityChecker:
         from .. import kzg as KZ
         block_root = self._structural_check(sidecar)
         try:
-            ok = KZ.verify_blob_kzg_proof_batch(
+            ok = self._verify_batch(
                 [bytes(sidecar.blob)], [bytes(sidecar.kzg_commitment)],
-                [bytes(sidecar.kzg_proof)], self.setup)
+                [bytes(sidecar.kzg_proof)])
         except KZ.KzgError as e:
             self._rejected.inc()
             raise BlobSidecarError(f"malformed blob/commitment: {e}") from e
@@ -119,10 +150,10 @@ class DataAvailabilityChecker:
         if not sidecars:
             return
         try:
-            ok = KZ.verify_blob_kzg_proof_batch(
+            ok = self._verify_batch(
                 [bytes(sc.blob) for sc in sidecars],
                 [bytes(sc.kzg_commitment) for sc in sidecars],
-                [bytes(sc.kzg_proof) for sc in sidecars], self.setup)
+                [bytes(sc.kzg_proof) for sc in sidecars])
         except KZ.KzgError as e:
             self._rejected.inc(len(sidecars))
             raise BlobSidecarError(f"malformed blob batch: {e}") from e
@@ -159,17 +190,48 @@ class DataAvailabilityChecker:
                 f"for commitment indices {missing}")
 
     def hold_executed_block(self, block_root: bytes, executed) -> None:
-        """Park a fully-verified-but-blobless block for cheap resume."""
+        """Park a fully-verified-but-blobless block for cheap resume.
+        Re-parking refreshes the TTL (a retry with still-missing blobs
+        is live interest, not a leak)."""
         with self._lock:
-            self._pending_blocks[block_root] = executed
+            self._pending_blocks[block_root] = (executed, self._clock())
+            self._expire_parked_locked()
 
     def pop_executed_block(self, block_root: bytes):
         with self._lock:
-            return self._pending_blocks.pop(block_root, None)
+            self._expire_parked_locked()
+            got = self._pending_blocks.pop(block_root, None)
+            return None if got is None else got[0]
 
     def peek_executed_block(self, block_root: bytes):
         with self._lock:
-            return self._pending_blocks.get(block_root)
+            self._expire_parked_locked()
+            got = self._pending_blocks.get(block_root)
+            return None if got is None else got[0]
+
+    def _expire_parked_locked(self) -> None:
+        """Caller holds the lock.  Drop parked blocks past the TTL, then
+        oldest-first beyond the count cap — bounded memory even when the
+        slot clock (and therefore :meth:`prune`) is stalled."""
+        now = self._clock()
+        dead = [root for root, (_ex, t0) in self._pending_blocks.items()
+                if now - t0 > self.PARKED_BLOCK_TTL_S]
+        for root in dead:
+            del self._pending_blocks[root]
+        while len(self._pending_blocks) > self.MAX_PARKED_BLOCKS:
+            oldest = min(self._pending_blocks,
+                         key=lambda r: self._pending_blocks[r][1])
+            del self._pending_blocks[oldest]
+            dead.append(oldest)
+        if dead:
+            self._expired.inc(len(dead))
+
+    def expire_parked(self) -> int:
+        """Public expiry sweep (the chain's per-slot task); returns the
+        parked-block count after expiry."""
+        with self._lock:
+            self._expire_parked_locked()
+            return len(self._pending_blocks)
 
     def take_sidecars(self, block_root: bytes) -> List:
         """Drain the cached sidecars for an imported block (persisted to
@@ -203,8 +265,10 @@ class DataAvailabilityChecker:
                 if any(live(int(sc.signed_block_header.message.slot))
                        for sc in scs.values())}
             self._pending_blocks = {
-                root: ex for root, ex in self._pending_blocks.items()
+                root: (ex, t0)
+                for root, (ex, t0) in self._pending_blocks.items()
                 if live(int(ex.signed_block.message.slot))}
+            self._expire_parked_locked()
 
 
 def build_blob_sidecars(signed_block, blobs, setup, preset, T,

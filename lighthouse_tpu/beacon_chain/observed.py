@@ -5,6 +5,7 @@ we have already seen, so gossip floods cannot re-enter the pipelines."""
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Set, Tuple
 
 import numpy as np
@@ -17,23 +18,35 @@ class ObservedAttesters:
     def __init__(self, horizon_epochs: int = 2):
         self.horizon = horizon_epochs
         self._by_epoch: Dict[int, Set[int]] = {}
+        # observe() is the streaming path's atomic observe-if-fresh
+        # primitive: concurrent completion callbacks (different pump
+        # threads finishing duplicate gossip copies) race through the
+        # check-then-add, and the GIL does not make that pair atomic.
+        self._lock = threading.Lock()
 
     def observe(self, epoch: int, validator_index: int) -> bool:
-        """Returns True if NEW (and records it); False if already seen."""
-        seen = self._by_epoch.setdefault(epoch, set())
-        if validator_index in seen:
-            return False
-        seen.add(validator_index)
-        return True
+        """Returns True if NEW (and records it); False if already seen.
+        Atomic: exactly one of N concurrent callers gets True."""
+        with self._lock:
+            seen = self._by_epoch.setdefault(epoch, set())
+            if validator_index in seen:
+                return False
+            seen.add(validator_index)
+            return True
 
     def has_attested(self, epoch: int, validator_index: int) -> bool:
         """Peek (no recording) — the doppelganger liveness probe."""
         return validator_index in self._by_epoch.get(epoch, set())
 
     def prune(self, current_epoch: int) -> None:
-        for e in [e for e in self._by_epoch
-                  if e + self.horizon < current_epoch]:
-            del self._by_epoch[e]
+        # Same lock as observe(): a prune racing two concurrent observes
+        # of duplicate copies could delete the epoch set between them,
+        # letting BOTH win the observe — the exact double-registration
+        # the lock exists to prevent.
+        with self._lock:
+            for e in [e for e in self._by_epoch
+                      if e + self.horizon < current_epoch]:
+                del self._by_epoch[e]
 
 
 class ObservedAggregators(ObservedAttesters):

@@ -1,0 +1,18 @@
+"""Shared retry-backoff policy.
+
+One implementation of exponential-backoff-with-jitter for every retry
+loop in the process (the device resilience envelope, the engine-API
+transport): ``min(base * 2^attempt, max)`` scaled by a uniform jitter in
+``[0.5, 1.5)`` so concurrent retriers decorrelate instead of hammering
+a recovering dependency in lockstep.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay(attempt: int, *, base_s: float, max_s: float,
+                  rng: random.Random) -> float:
+    """Delay before retry number ``attempt`` (0-based)."""
+    return min(base_s * (2 ** attempt), max_s) * (0.5 + rng.random())

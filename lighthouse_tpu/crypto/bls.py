@@ -298,5 +298,23 @@ def get_backend():
     return _active
 
 
+# Optional dispatch wrapper — the resilience seam.  When set (by
+# `beacon_chain.verification_service.install_global_envelope`), every
+# module-level `verify_signature_sets` call routes through
+# `wrapper(active_backend, sets)`, which adds deadline/retry/circuit-
+# breaker/host-fallback around the device dispatch.  Backends invoked
+# DIRECTLY (`get_backend().verify_signature_sets`) bypass it — that is
+# how the wrapper itself calls the device without recursing.
+_dispatch_wrapper = None
+
+
+def set_dispatch_wrapper(wrapper) -> None:
+    """Install (or clear, with None) the global dispatch wrapper."""
+    global _dispatch_wrapper
+    _dispatch_wrapper = wrapper
+
+
 def verify_signature_sets(sets: Sequence[SignatureSet]) -> bool:
+    if _dispatch_wrapper is not None:
+        return _dispatch_wrapper(_active, sets)
     return _active.verify_signature_sets(sets)

@@ -20,11 +20,14 @@ import hashlib
 import hmac
 import http.client
 import json
+import random
 import time
 from typing import Any, List, Optional
 from urllib.parse import urlparse
 
 from . import Engine, EngineError, PayloadStatus
+from ..common.backoff import backoff_delay
+from ..common.metrics import REGISTRY
 
 # Method names + timeouts (`engine_api/http.rs:30-50`).
 ETH_SYNCING = "eth_syncing"
@@ -175,13 +178,34 @@ class HttpJsonRpcEngine(Engine):
     HttpJsonRpc + `engines.rs` Engine).  Thread-compatible: callers
     serialize through the ExecutionLayer's first-up routing."""
 
-    def __init__(self, url: str, jwt: JwtAuth):
+    # Transport-failure retry policy: a flaky engine connection (restart,
+    # LB blip, slow disk stall) should cost backoff, not an immediate
+    # missed payload — the same backoff+jitter discipline as the device
+    # resilience envelope.  Only TRANSPORT failures and 5xx responses
+    # retry; JSON-RPC application errors are the engine's answer and
+    # surface immediately.
+    RETRIES = 3
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_MAX_S = 1.0
+
+    def __init__(self, url: str, jwt: JwtAuth, *,
+                 retries: Optional[int] = None, sleep=time.sleep,
+                 rng: Optional[random.Random] = None):
         self.url = url
         self.jwt = jwt
         self._parsed = urlparse(url)
         self._conn: Optional[http.client.HTTPConnection] = None
         self._id = 0
         self.capabilities: Optional[List[str]] = None
+        self.retries = self.RETRIES if retries is None else int(retries)
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self.retry_counts: dict = {}  # method → retries performed
+        self._m_retries = REGISTRY.counter(
+            "engine_api_retries_total", "engine-API transport retries")
+        self._m_failures = REGISTRY.counter(
+            "engine_api_transport_failures_total",
+            "engine-API calls failed after all retries")
 
     # -- wire ---------------------------------------------------------------
 
@@ -189,6 +213,21 @@ class HttpJsonRpcEngine(Engine):
         host = self._parsed.hostname or "127.0.0.1"
         port = self._parsed.port or 8551
         return http.client.HTTPConnection(host, port, timeout=timeout)
+
+    def _backoff(self, attempt: int) -> None:
+        self._sleep(backoff_delay(attempt, base_s=self.BACKOFF_BASE_S,
+                                  max_s=self.BACKOFF_MAX_S, rng=self._rng))
+
+    def _note_retry(self, method: str, attempt: int, attempts: int,
+                    err_msg: str) -> None:
+        """Account one transient failure: raise on the final attempt,
+        otherwise count the retry and back off."""
+        if attempt == attempts - 1:
+            self._m_failures.inc()
+            raise EngineError(err_msg)
+        self.retry_counts[method] = self.retry_counts.get(method, 0) + 1
+        self._m_retries.inc()
+        self._backoff(attempt)
 
     def rpc(self, method: str, params: list) -> Any:
         self._id += 1
@@ -199,8 +238,11 @@ class HttpJsonRpcEngine(Engine):
             "Authorization": "Bearer " + self.jwt.token(),
         }
         timeout = TIMEOUTS.get(method, 8.0)
-        for attempt in (0, 1):  # one silent reconnect on a dead keep-alive
+        attempts = self.retries + 1
+        attempt = 0
+        while True:
             conn = self._conn
+            reused = conn is not None
             if conn is None:
                 conn = self._connect(timeout)
             try:
@@ -208,12 +250,32 @@ class HttpJsonRpcEngine(Engine):
                 resp = conn.getresponse()
                 data = resp.read()
                 self._conn = conn
-                break
             except (OSError, http.client.HTTPException) as e:
                 conn.close()
                 self._conn = None
-                if attempt:
-                    raise EngineError(f"{method}: transport failure: {e}")
+                if reused:
+                    # Dead keep-alive after an idle gap is routine (the
+                    # engine reaped the connection): reconnect
+                    # immediately — no backoff, no retry metric, and no
+                    # attempt consumed (the seed's "one silent
+                    # reconnect"; works even with retries=0).  At most
+                    # once per call: self._conn is now None, so the
+                    # retried iteration cannot be `reused` again.
+                    continue
+                self._note_retry(method, attempt, attempts,
+                                 f"{method}: transport failure after "
+                                 f"{attempts} attempts: {e}")
+                attempt += 1
+                continue
+            if resp.status >= 500:  # engine-side transient (proxy 502s...)
+                self._conn = None
+                conn.close()
+                self._note_retry(method, attempt, attempts,
+                                 f"{method}: HTTP {resp.status} after "
+                                 f"{attempts} attempts")
+                attempt += 1
+                continue
+            break
         if resp.status != 200:
             raise EngineError(f"{method}: HTTP {resp.status}")
         try:

@@ -69,6 +69,11 @@ class BeaconProcessor:
 
     def __init__(self, max_workers: int = 4):
         self.max_workers = max_workers
+        # Streaming verification service (beacon_chain.verification_
+        # service): when attached, the processor pumps it at every idle
+        # point, so SLO-deadline dispatches fire even with empty queues,
+        # and run_until_idle's drain contract extends to it.
+        self.verification_service = None
         self.queues: Dict[WorkType, Deque[WorkEvent]] = {
             wt: deque() for wt in WorkType}
         self.dropped: Dict[WorkType, int] = {wt: 0 for wt in WorkType}
@@ -76,6 +81,7 @@ class BeaconProcessor:
         self._reprocess: List[Tuple[float, int, WorkEvent]] = []
         self._seq = 0
         self._active = 0
+        self._pumping = False
         self._shutdown = False
         self._workers: List[threading.Thread] = []
         self._manager: Optional[threading.Thread] = None
@@ -147,6 +153,16 @@ class BeaconProcessor:
             with self._lock:
                 ev = self._pop_next()
             if ev is None:
+                svc = self.verification_service
+                if svc is not None and svc.pending():
+                    # Synchronous drain semantics: everything submitted
+                    # to the streaming verifier completes before this
+                    # returns.  flush() also waits out messages a
+                    # concurrent pump thread holds in flight, so even a
+                    # 0-dispatch flush is progress (their callbacks may
+                    # enqueue follow-up work) — loop again regardless.
+                    processed += svc.flush()
+                    continue
                 if self._reprocess:
                     t = self._reprocess[0][0] - time.monotonic()
                     if t > 0 and time.monotonic() + t < deadline:
@@ -170,7 +186,6 @@ class BeaconProcessor:
         self._manager.start()
 
     def _manager_loop(self) -> None:
-        pool: List[threading.Thread] = []
         while True:
             with self._lock:
                 if self._shutdown:
@@ -178,15 +193,41 @@ class BeaconProcessor:
                 ev = self._pop_next()
                 if ev is None:
                     self._lock.wait(timeout=0.05)
-                    continue
-                while self._active >= self.max_workers:
-                    self._lock.wait(timeout=0.05)
-                    if self._shutdown:
-                        return
-                self._active += 1
+                else:
+                    while self._active >= self.max_workers:
+                        self._lock.wait(timeout=0.05)
+                        if self._shutdown:
+                            return
+                    self._active += 1
+            if ev is None:
+                # Idle tick: SLO-driven dispatch of the streaming
+                # verifier's due buckets — on a worker thread, never the
+                # manager: a pump rides the resilience envelope (deadline
+                # waits, backoff sleeps, host-oracle fallback), and a
+                # wedged device would stall dispatch of every queued
+                # work event behind an inline pump.  One pump thread at
+                # a time; only the manager sets the flag.
+                # Gate on due-ness, not mere pending-ness: a message
+                # sitting inside its SLO window would otherwise spawn a
+                # no-op pump thread every 50 ms tick.
+                svc = self.verification_service
+                if svc is not None and not self._pumping \
+                        and svc.has_due_work():
+                    self._pumping = True
+                    threading.Thread(target=self._pump_service,
+                                     args=(svc,), daemon=True).start()
+                continue
             t = threading.Thread(target=self._run_one, args=(ev,),
                                  daemon=True)
             t.start()
+
+    def _pump_service(self, svc) -> None:
+        try:
+            svc.pump()
+        except Exception:  # noqa: BLE001 — pump must not kill workers
+            pass
+        finally:
+            self._pumping = False
 
     def _run_one(self, ev: WorkEvent) -> None:
         try:

@@ -85,6 +85,12 @@ class NetworkNode:
         self.name = name
         self.log = (log or test_logger()).child(name)
         self.processor = BeaconProcessor()
+        # Streaming verification: gossip-path signature/KZG checks flow
+        # through the chain's resilient service (adaptive micro-batching
+        # + circuit breaker + host fallback); the processor pumps its
+        # SLO-due buckets at every idle point.
+        chain.ensure_verification_service()
+        self.processor.verification_service = chain.verification_service
         self.peers: List["NetworkNode"] = []
         from .peer_manager import PeerManager
         self.peer_manager = PeerManager(log=self.log)
@@ -111,6 +117,15 @@ class NetworkNode:
         for subnet in range(BLOB_SIDECAR_SUBNET_COUNT):
             bus.subscribe(TOPIC_BLOB_SIDECAR.format(subnet),
                           self._blob_handler)
+
+    def close(self) -> None:
+        """Tear the node down: stop the processor and release the
+        chain's streaming-verification hooks — including this node's
+        refcount on the process-global BLS envelope, so a dead node's
+        breaker state cannot route later module-level verifies through
+        watchdogs/host fallback."""
+        self.processor.stop()
+        self.chain.release_verification_service()
 
     # -- publishing ----------------------------------------------------------
 
@@ -208,7 +223,7 @@ class NetworkNode:
         if subnet in self.subnets:
             return
         self.subnets.add(subnet)
-        handler = self._on_gossip_attestation
+        handler = self._on_gossip_subnet_attestation
         self._subnet_handlers[subnet] = handler
         self.bus.subscribe(TOPIC_ATTESTATION_SUBNET.format(subnet), handler)
 
@@ -220,7 +235,7 @@ class NetworkNode:
         handler = self._subnet_handlers.get(subnet)
         self.bus.publish(topic, [att], exclude=handler)
         if subnet in self.subnets:
-            self._on_gossip_attestation([att])
+            self._on_gossip_subnet_attestation([att])
 
     # -- gossip handlers → processor queues ----------------------------------
 
@@ -229,6 +244,15 @@ class NetworkNode:
             WorkType.GossipBlock, signed_block, self._process_block))
 
     def _on_gossip_attestation(self, atts: List) -> None:
+        """Aggregate-topic traffic: never shed by the verify service."""
+        for att in atts:
+            self.processor.submit(WorkEvent(
+                WorkType.GossipAggregateBatch, att,
+                self._process_aggregate_batch))
+
+    def _on_gossip_subnet_attestation(self, atts: List) -> None:
+        """Subnet (unaggregated) traffic: the sheddable class — under
+        overload these degrade FIRST, never aggregates or blocks."""
         for att in atts:
             self.processor.submit(WorkEvent(
                 WorkType.GossipAttestationBatch, att,
@@ -290,7 +314,10 @@ class NetworkNode:
             self._publish_lc_updates()
 
     def _process_attestation_batch(self, atts: List) -> None:
-        self.chain.process_attestation_batch(atts)
+        self.chain.stream_attestation_batch(atts, kind="attestation")
+
+    def _process_aggregate_batch(self, atts: List) -> None:
+        self.chain.stream_attestation_batch(atts, kind="aggregate")
 
     # -- Req/Resp ------------------------------------------------------------
 

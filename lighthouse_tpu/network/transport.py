@@ -656,6 +656,7 @@ class WireNetwork:
             pass
         for c in list(self._conns):
             c.close()
+        self.node.close()
 
     def _on_close(self, conn: _Conn) -> None:
         with self._lock:
@@ -865,7 +866,7 @@ class WireNetwork:
                     subnet = int(topic.rsplit("_", 1)[-1])
                     if subnet in self.node.subnets:
                         deliver = lambda: \
-                            self.node._on_gossip_attestation(obj)
+                            self.node._on_gossip_subnet_attestation(obj)
                     else:
                         deliver = lambda: None
                 else:

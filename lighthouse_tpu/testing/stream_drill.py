@@ -1,0 +1,215 @@
+"""Shared hostile-drill driver for the streaming verification service.
+
+One implementation of the drill the acceptance criterion describes —
+replay a deterministic message stream (steady arrivals + gossip bursts)
+through a :class:`~lighthouse_tpu.beacon_chain.verification_service.
+VerificationService` with seeded fault injection on the device-dispatch
+site, then account for every message — used by BOTH
+``scripts/validate_stream_verify.py`` (CLI, exit-code contract) and
+``bench.py``'s ``stream_verify`` row (p50/p99 vs SLO, batch-size
+histogram, shed/fallback counts), so the number the bench reports is the
+number the validator checks.
+
+The drill's claim is *zero valid messages lost*: every submitted message
+completes verified — on the device path, after a retry, on a half-open
+probe, or on the host-fallback path while the circuit breaker is open —
+and nothing is shed or rejected.  ``run_drill`` raises nothing on loss;
+it reports ``lost`` / ``zero_loss`` and leaves the verdict to callers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+from .faults import FaultInjector, burst_schedule
+
+
+def build_sets(n: int, *, keys_per_set: int = 1, real_keys: bool = False,
+               seed: int = 0):
+    """``n`` valid single-message SignatureSets.  ``real_keys`` signs
+    for real (interop-style secrets — the python/tpu backends verify
+    them); otherwise the sets are structural stand-ins the fake backend
+    accepts (non-infinity signature, non-empty key list)."""
+    from ..crypto import bls
+
+    if not real_keys:
+        sig = bls.Signature((0, 0))
+        pks = [bls.PublicKey((1 + i, 2)) for i in range(keys_per_set)]
+        return [bls.SignatureSet(sig, list(pks), b"drill-%05d" % i)
+                for i in range(n)]
+    from ..crypto.fields import R
+    sk_ints = [0x20000 + 13 * (seed + 1) + 7 * i
+               for i in range(keys_per_set)]
+    sks = [bls.SecretKey(v) for v in sk_ints]
+    pks = [k.public_key() for k in sks]
+    agg = bls.SecretKey(sum(sk_ints) % R)
+    out = []
+    for i in range(n):
+        m = b"drill-%05d" % i
+        out.append(bls.SignatureSet(agg.sign(m), list(pks), m))
+    return out
+
+
+def run_drill(*, n_messages: int = 96, rate_per_s: float = 200.0,
+              burst_every: int = 16, burst_size: int = 8,
+              fail_rate: float = 0.10,
+              outage: Optional[Tuple[int, int]] = None,
+              h2d_stall: Tuple[float, float] = (0.0, 0.0),
+              slo_ms: float = 250.0, max_batch: int = 32,
+              keys_per_set: int = 1, backend: Optional[str] = None,
+              real_keys: bool = False, realtime: bool = True,
+              dispatch_model_ms: Optional[Tuple[float, float]] = None,
+              aggregate_every: int = 8, seed: int = 0,
+              retries: int = 2, breaker_threshold: int = 3,
+              probe_cooldown_s: float = 0.05,
+              backoff_base_s: float = 0.01,
+              recovery_tail: int = 8) -> dict:
+    """Run one drill and return the full accounting dict.
+
+    ``backend``            switch the active bls backend for the drill
+                           (restored after); None keeps the current one.
+    ``dispatch_model_ms``  ``(base, per_set)`` — replace the backend
+                           dispatch with a modeled fixed-cost verify
+                           (sleep base + per_set·|sets| ms, then
+                           structural validity).  The bench row uses
+                           this: it measures the SERVICE's batching /
+                           resilience policy, not crypto throughput
+                           (the bls rows own that number).
+    ``realtime``           honor inter-arrival gaps against the wall
+                           clock (p50/p99 then measure the SLO policy);
+                           False replays the stream compressed.
+    ``outage``             (start, stop) per-site dispatch sequence
+                           window where EVERY device attempt fails —
+                           the sustained-outage scenario that must trip
+                           the breaker and route to host.
+    ``recovery_tail``      after the main stream, disarm injection and
+                           trickle this many extra messages so the
+                           half-open probe has traffic to ride — the
+                           drill ends with the breaker re-closed and
+                           traffic back on the device (``recovered`` in
+                           the result).  0 skips the tail.
+    """
+    from ..crypto import bls
+
+    prev_backend = bls.get_backend()
+    if backend is not None:
+        bls.set_backend(backend)
+    try:
+        inj = FaultInjector(seed=seed)
+        plan_kw: dict = {}
+        if fail_rate > 0:
+            plan_kw["fail_rate"] = fail_rate
+        if outage is not None:
+            plan_kw["outage"] = tuple(outage)
+        if plan_kw:
+            inj.plan("bls_dispatch", **plan_kw)
+        if h2d_stall[0] > 0:
+            inj.plan("h2d", stall_rate=h2d_stall[0], stall_s=h2d_stall[1])
+
+        from ..beacon_chain.verification_service import VerificationService
+
+        device_verify = None
+        if dispatch_model_ms is not None:
+            base_s = dispatch_model_ms[0] / 1e3
+            per_s = dispatch_model_ms[1] / 1e3
+
+            def device_verify(sets):  # noqa: F811 — the modeled dispatch
+                time.sleep(base_s + per_s * len(sets))
+                return all(s.signature is not None and s.signing_keys
+                           for s in sets)
+
+        svc = VerificationService(
+            slo_ms=slo_ms, max_batch=max_batch, retries=retries,
+            backoff_base_s=backoff_base_s,
+            breaker_threshold=breaker_threshold,
+            probe_cooldown_s=probe_cooldown_s, seed=seed, faults=inj,
+            device_verify=device_verify, name="drill")
+
+        sets = build_sets(n_messages, keys_per_set=keys_per_set,
+                          real_keys=real_keys, seed=seed)
+        offsets = burst_schedule(n_messages, rate_per_s,
+                                 burst_every=burst_every,
+                                 burst_size=burst_size, seed=seed)
+        offsets = offsets[:n_messages]
+
+        results = []
+        t_start = time.monotonic()
+        for i, off in enumerate(offsets):
+            if realtime:
+                while True:
+                    svc.pump()  # SLO-due buckets dispatch while we wait
+                    now = time.monotonic() - t_start
+                    if off <= now:
+                        break
+                    time.sleep(min(0.002, off - now))
+            kind = ("aggregate" if aggregate_every > 0
+                    and i % aggregate_every == 0 else "attestation")
+            svc.submit(kind, [sets[i]],
+                       on_result=lambda ok, path: results.append((ok, path)))
+            if not realtime and i % max_batch == max_batch - 1:
+                svc.pump()
+        svc.flush()
+
+        # Recovery tail: the stream may end mid-outage with the breaker
+        # open — disarm injection and trickle a few more messages so the
+        # half-open probe has traffic to ride and the drill can assert
+        # the device RESUMED, not just that host fallback carried it.
+        n_tail = 0
+        if recovery_tail > 0 and plan_kw:
+            inj.disarm("bls_dispatch")
+            tail_sets = build_sets(recovery_tail,
+                                   keys_per_set=keys_per_set,
+                                   real_keys=real_keys, seed=seed + 1)
+            deadline = time.monotonic() + max(
+                5.0, 20 * probe_cooldown_s)
+            while n_tail < recovery_tail:
+                svc.submit("attestation", [tail_sets[n_tail]],
+                           on_result=lambda ok, path:
+                           results.append((ok, path)))
+                n_tail += 1
+                time.sleep(svc.envelope.breaker.cooldown_s)
+                svc.flush()
+                if svc.envelope.breaker.state == "closed" \
+                        and n_tail >= min(2, recovery_tail):
+                    break
+                if time.monotonic() > deadline:
+                    break
+        wall_s = time.monotonic() - t_start
+
+        st = svc.stats()
+        paths: dict = {}
+        for _ok, p in results:
+            paths[p] = paths.get(p, 0) + 1
+        ok_count = sum(1 for ok, _ in results if ok)
+        n_total = n_messages + n_tail
+        lost = n_total - ok_count
+        return {
+            "messages": n_total,
+            "stream_messages": n_messages,
+            "recovery_tail_messages": n_tail,
+            "recovered": svc.envelope.breaker.state == "closed",
+            "completed": len(results),
+            "verified_ok": ok_count,
+            "lost": lost,
+            "zero_loss": lost == 0 and st["shed"] == 0
+            and st["rejected"] == 0,
+            "result_paths": paths,
+            "wall_s": round(wall_s, 3),
+            "slo_ms": st["slo_ms"],
+            "latency_p50_ms": st["latency_p50_ms"],
+            "latency_p99_ms": st["latency_p99_ms"],
+            "latency_max_ms": st["latency_max_ms"],
+            "slo_violations": st["slo_violations"],
+            "batch_size_hist": st["batch_size_hist"],
+            "dispatches": st["dispatches"],
+            "splits": st["splits"],
+            "shed": st["shed"],
+            "rejected": st["rejected"],
+            "envelope": st["bls"],
+            "injector": inj.stats(),
+            "pipeline": st["pipeline"],
+        }
+    finally:
+        if backend is not None:  # only restore when we actually switched
+            bls.set_backend(getattr(prev_backend, "name", "python"))

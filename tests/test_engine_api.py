@@ -157,3 +157,38 @@ def test_unauthenticated_request_fails(engine):
     engine.jwt = JwtAuth(b"\x99" * 32)  # wrong secret
     with pytest.raises(EngineError):
         engine.new_payload(_capella_payload())
+
+
+class _DeadConn:
+    """Stands in for a keep-alive connection the engine already reaped."""
+
+    def request(self, *a, **k):
+        raise OSError("connection reset by peer")
+
+    def close(self):
+        pass
+
+
+def test_dead_keepalive_reconnects_without_backoff(engine):
+    sleeps = []
+    engine._sleep = sleeps.append
+    assert engine.rpc("eth_syncing", []) is False
+    # The engine reaped the idle keep-alive: the next call's first
+    # attempt fails on the reused connection.  That is routine — it must
+    # reconnect immediately, without a backoff sleep and without
+    # counting a retry (a healthy engine must not read as flaky).
+    engine._conn = _DeadConn()
+    assert engine.rpc("eth_syncing", []) is False
+    assert sleeps == []
+    assert engine.retry_counts == {}
+
+
+def test_dead_keepalive_reconnect_survives_retries_zero(engine):
+    # The free reconnect lives OUTSIDE the retry budget: even with
+    # transport retries disabled, a reaped keep-alive must not surface
+    # as an EngineError (the seed always absorbed one silent reconnect).
+    engine.retries = 0
+    assert engine.rpc("eth_syncing", []) is False
+    engine._conn = _DeadConn()
+    assert engine.rpc("eth_syncing", []) is False
+    assert engine.retry_counts == {}

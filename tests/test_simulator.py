@@ -101,3 +101,95 @@ def test_network_with_hostile_peers_finalizes():
         spam.close()
     finally:
         sim.close()
+
+
+@pytest.mark.timeout(300)
+def test_hostile_drill_device_faults_zero_message_loss():
+    """ISSUE 7 acceptance drill: live gossip + a synthetic burst + 10%
+    injected device-dispatch faults + one sustained outage window on
+    node0's streaming verification service.  Asserts the full chain of
+    degradation: circuit breaker trips → host fallback carries the
+    stream → recovery probe → device resumes (breaker re-closed), with
+    ZERO valid messages lost (nothing shed, nothing rejected, every
+    submission completes) and the mesh still converging + finalizing.
+
+    Everything runs on the fake backend (module fixture): no device
+    programs, quick tier."""
+    from lighthouse_tpu.testing.faults import FaultInjector, burst_schedule
+
+    sim = Simulator(n_nodes=3, n_validators=16)
+    try:
+        assert sim.wait_for_mesh()
+        svc = sim.nodes[0].chain.verification_service
+        assert svc is not None, "NetworkNode did not wire the service"
+
+        # Arm node0's service: deterministic injector, tight breaker so
+        # the drill trips + recovers well inside the run.
+        inj = FaultInjector(seed=11)
+        svc._faults = inj
+        svc.envelope._faults = inj
+        svc.envelope.retries = 1
+        svc.envelope.breaker.threshold = 3
+        svc.envelope.breaker.base_cooldown_s = 0.1
+        svc.envelope.breaker.cooldown_s = 0.1
+
+        # Phase 1 (slots 1-8): intermittent 10% dispatch faults + H2D
+        # stalls under live gossip — absorbed by retry/backoff and the
+        # staged executor's sync-staging fallback.
+        inj.plan("bls_dispatch", fail_rate=0.10)
+        inj.plan("h2d", stall_rate=0.05, stall_s=0.01)
+        for slot in range(1, 9):
+            sim.run_slot(slot)
+
+        # Phase 2 (slots 9-16): sustained outage window (every dispatch
+        # attempt fails) + a gossip burst landing in one flush.
+        seq = inj.calls.get("bls_dispatch", 0)
+        inj.plan("bls_dispatch", fail_rate=0.10, outage=(seq, seq + 6))
+        burst_results = []
+        sig = B.Signature((0, 0))
+        pk = B.PublicKey((1, 2))
+        n_burst = len(burst_schedule(48, 400.0, burst_every=12,
+                                     burst_size=4, seed=5))
+        for i in range(n_burst):
+            sset = B.SignatureSet(sig, [pk], b"drill-%d" % i)
+            assert svc.submit(
+                "attestation", [sset],
+                on_result=lambda ok, path: burst_results.append((ok, path)))
+        for slot in range(9, 17):
+            sim.run_slot(slot)
+
+        # Phase 3 (slots 17-32): faults disarmed — the next recovery
+        # probe must succeed and traffic must return to the device.
+        inj.disarm()
+        for slot in range(17, 33):
+            sim.run_slot(slot)
+        svc.flush()
+
+        # Zero valid-message loss: every burst message completed OK and
+        # the service's global accounting shows nothing shed/rejected.
+        assert len(burst_results) == n_burst
+        assert all(ok for ok, _ in burst_results), \
+            "a valid burst message was lost"
+        burst_paths = {p for _, p in burst_results}
+        assert "host" in burst_paths, "outage never degraded to host"
+        st = svc.stats()
+        assert st["pending"] == 0
+        assert st["shed"] == 0 and st["rejected"] == 0
+        assert st["verified"] == st["submitted"]
+        assert st["verified"] > n_burst  # live gossip flowed through too
+
+        # Degradation chain: trip → host fallback → probe → re-close.
+        env = svc.envelope.snapshot()
+        assert inj.stats()["injected"]["bls_dispatch"] >= 6
+        assert env["breaker"]["trips"] >= 1, "outage never tripped"
+        assert env["host_fallbacks"] >= 1
+        assert env["probes"] >= 1
+        assert env["breaker"]["recoveries"] >= 1, "probe never recovered"
+        assert env["breaker"]["state"] == "closed", "device never resumed"
+        assert env["device_ok"] >= 1
+
+        # The degraded node kept up: one head, finality advanced.
+        assert len(sim.heads()) == 1
+        assert min(sim.finalized_epochs()) >= 2
+    finally:
+        sim.close()

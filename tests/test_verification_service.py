@@ -1,0 +1,995 @@
+"""Streaming verification service: circuit breaker, resilience envelope,
+adaptive micro-batching, overload shedding, fault-injection determinism,
+parked-block expiry, engine-API retries.
+
+Everything here is host logic with stub verifiers and fake clocks — no
+device programs, so the whole module stays in the quick tier."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon_chain.verification_service import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    ResilienceEnvelope,
+    VerificationService,
+)
+from lighthouse_tpu.testing.faults import (
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    burst_schedule,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class SleepRecorder:
+    def __init__(self, clock=None):
+        self.calls = []
+        self.clock = clock
+
+    def __call__(self, dt):
+        self.calls.append(dt)
+        if self.clock is not None:
+            self.clock.advance(dt)
+
+
+def make_service(clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("slo_ms", 100.0)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("deadline_ms", 0)  # 0 → deadline DISABLED (no watchdog)
+    kw.setdefault("retries", 1)
+    kw.setdefault("breaker_threshold", 3)
+    kw.setdefault("probe_cooldown_s", 1.0)
+    kw.setdefault("seed", 0)
+    kw.setdefault("sleep", SleepRecorder(clock))
+    # Unit tests step the dispatch policy with explicit pump() calls;
+    # production wiring keeps the self-pumping ingress (tested below).
+    kw.setdefault("auto_pump", False)
+    svc = VerificationService(clock=clock, **kw)
+    return svc, clock
+
+
+@pytest.fixture(autouse=True)
+def _quiet_breaker_registry():
+    # Breakers self-register globally (bench attribution); tests create
+    # many — keep the registry from growing across the module.
+    from lighthouse_tpu.beacon_chain import verification_service as V
+    yield
+    with V._BREAKERS_LOCK:
+        V._BREAKERS.clear()
+
+
+class FakeSet:
+    """Stands in for bls.SignatureSet: the service only reads
+    ``signing_keys`` (for bucketing)."""
+
+    class _P:
+        def __init__(self, x):
+            self.point = (x, 0)
+
+    def __init__(self, n_keys=1, valid=True, key_id=0):
+        self.signing_keys = [self._P(key_id + i) for i in range(n_keys)]
+        self.valid = valid
+
+
+def batch_ok(sets):
+    return all(s.valid for s in sets)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_after_threshold_and_probes_after_cooldown():
+    clock = FakeClock()
+    b = CircuitBreaker("t1", threshold=3, cooldown_s=1.0, clock=clock)
+    assert b.route() == "device"
+    b.record(False)
+    b.record(False)
+    assert b.state == "closed"
+    b.record(False)  # third consecutive → trip
+    assert b.state == "open" and b.trips == 1
+    assert b.route() == "host"
+    clock.advance(0.5)
+    assert b.route() == "host"  # cooldown not expired
+    clock.advance(0.6)
+    assert b.route() == "probe"  # exactly one caller gets the probe
+    assert b.route() == "host"   # ...everyone else stays degraded
+    b.record(True, probe=True)
+    assert b.state == "closed" and b.recoveries == 1
+    assert b.route() == "device"
+
+
+def test_breaker_failed_probe_doubles_cooldown():
+    clock = FakeClock()
+    b = CircuitBreaker("t2", threshold=1, cooldown_s=1.0,
+                       cooldown_max_s=3.0, clock=clock)
+    b.record(False)
+    assert b.state == "open"
+    clock.advance(1.1)
+    assert b.route() == "probe"
+    b.record(False, probe=True)
+    assert b.state == "open" and b.reopens == 1
+    assert b.cooldown_s == 2.0
+    clock.advance(1.5)
+    assert b.route() == "host"  # doubled cooldown not yet expired
+    clock.advance(0.6)
+    assert b.route() == "probe"
+    b.record(True, probe=True)
+    assert b.cooldown_s == 1.0  # reset on recovery
+
+
+# ---------------------------------------------------------------------------
+# Resilience envelope
+# ---------------------------------------------------------------------------
+
+
+def test_envelope_retries_with_backoff_then_succeeds():
+    clock = FakeClock()
+    sleeper = SleepRecorder(clock)
+    env = ResilienceEnvelope("e1", retries=2, backoff_base_s=0.1,
+                             breaker_threshold=10, seed=7, clock=clock,
+                             sleep=sleeper)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    out, path = env.call(flaky, lambda: "host", ())
+    assert out == "ok" and path == "device_retry"
+    assert env.stats["retries"] == 2
+    assert env.stats["device_faults"] == 2
+    # Backoff is exponential with jitter in [0.5, 1.5) of the base step.
+    assert len(sleeper.calls) == 2
+    assert 0.05 <= sleeper.calls[0] < 0.15
+    assert 0.10 <= sleeper.calls[1] < 0.30
+
+
+def test_envelope_host_fallback_and_trip():
+    clock = FakeClock()
+    env = ResilienceEnvelope("e2", retries=1, breaker_threshold=3,
+                             probe_cooldown_s=5.0, seed=0, clock=clock,
+                             sleep=SleepRecorder(clock))
+
+    def dead():
+        raise RuntimeError("device gone")
+
+    out, path = env.call(dead, lambda: "host-result", ())
+    assert (out, path) == ("host-result", "host")
+    # 2 attempts happened; third call's first attempt trips the breaker.
+    out, path = env.call(dead, lambda: "host-result", ())
+    assert path == "host"
+    assert env.breaker.state == "open"
+    assert env.breaker.trips == 1
+    # While open, device_fn is not even attempted.
+    n_before = env.stats["device_faults"]
+    out, path = env.call(dead, lambda: "host-result", ())
+    assert path == "host" and env.stats["device_faults"] == n_before
+
+
+def test_envelope_deadline_abandons_wedged_dispatch():
+    env = ResilienceEnvelope("e3", deadline_s=0.05, retries=0,
+                             breaker_threshold=10)
+    release = threading.Event()
+
+    def wedged():
+        release.wait(2.0)
+        return True
+
+    out, path = env.call(wedged, lambda: "host", ())
+    assert (out, path) == ("host", "host")
+    assert env.stats["deadline_faults"] == 1
+    release.set()
+
+
+def test_envelope_passthrough_exceptions_are_not_faults():
+    env = ResilienceEnvelope("e4", retries=3, breaker_threshold=2)
+    env.passthrough = (ValueError,)
+
+    def malformed():
+        raise ValueError("bad data")
+
+    with pytest.raises(ValueError):
+        env.call(malformed, lambda: "host", ())
+    assert env.stats["device_faults"] == 0
+    assert env.breaker.state == "closed"
+
+
+def test_probe_released_on_passthrough_exception():
+    clock = FakeClock()
+    env = ResilienceEnvelope("e6", retries=0, breaker_threshold=1,
+                             probe_cooldown_s=1.0, clock=clock,
+                             sleep=SleepRecorder(clock))
+    env.passthrough = (ValueError,)
+
+    def dead():
+        raise RuntimeError("device down")
+
+    out, path = env.call(dead, lambda: "host", ())
+    assert path == "host" and env.breaker.state == "open"
+    clock.advance(1.1)
+
+    def malformed():
+        raise ValueError("bad data")
+
+    # The recovery probe happens to carry malformed data: the data error
+    # propagates to the caller, but the probe slot must be released —
+    # otherwise the breaker wedges half_open with _probing stuck True
+    # and routes every future dispatch to the host forever.
+    with pytest.raises(ValueError):
+        env.call(malformed, lambda: "host", ())
+    assert env.breaker.state == "half_open"
+    assert env.breaker.route() == "probe"
+
+
+def test_deadline_zero_disables_watchdog():
+    # 0 must mean "no deadline", NOT a zero-second deadline that
+    # abandons every attempt at birth and silently serves all traffic
+    # from the host while abandoned threads burn duplicate crypto.
+    svc, _ = make_service()
+    assert svc.envelope.deadline_s is None
+    assert svc.kzg_envelope.deadline_s is None
+    out, path = svc.envelope.call(lambda: "ok", None, ())
+    assert (out, path) == ("ok", "device")
+
+
+def test_envelope_no_host_fn_reraises():
+    env = ResilienceEnvelope("e5", retries=0, breaker_threshold=10)
+
+    def dead():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        env.call(dead, None, ())
+
+
+# ---------------------------------------------------------------------------
+# Adaptive micro-batching
+# ---------------------------------------------------------------------------
+
+
+def test_slo_deadline_drives_small_batches():
+    seen = []
+
+    def device(sets):
+        seen.append(len(sets))
+        return batch_ok(sets)
+
+    svc, clock = make_service(device_verify=device, slo_ms=100.0,
+                              max_batch=64)
+    for _ in range(3):
+        svc.submit("attestation", [FakeSet()])
+    # Too early: nothing is due (deadline - est still ahead).
+    assert svc.pump() == 0 and svc.pending() == 3
+    clock.advance(0.09)  # inside est-of-dispatch of the 100 ms SLO
+    assert svc.pump() == 3
+    assert seen == [3]
+    st = svc.stats()
+    assert st["verified"] == 3 and st["dispatches"] == 1
+
+
+def test_full_bucket_dispatches_fat_batch_under_load():
+    seen = []
+
+    def device(sets):
+        seen.append(len(sets))
+        return batch_ok(sets)
+
+    svc, clock = make_service(device_verify=device, slo_ms=10_000.0,
+                              max_batch=8)
+    for _ in range(20):
+        svc.submit("attestation", [FakeSet()])
+    # No SLO pressure at all — the full buckets alone dispatch.
+    done = svc.pump()
+    assert done >= 16
+    assert max(seen) == 8  # amortized cap
+    svc.flush()
+    assert svc.pending() == 0
+    assert sum(seen) == 20
+
+
+def test_buckets_keyed_by_padded_signer_count():
+    seen = []
+
+    def device(sets):
+        seen.append(sorted({len(s.signing_keys) for s in sets}))
+        return batch_ok(sets)
+
+    svc, clock = make_service(device_verify=device)
+    svc.submit("attestation", [FakeSet(n_keys=1)])
+    svc.submit("attestation", [FakeSet(n_keys=2)])
+    svc.submit("attestation", [FakeSet(n_keys=1)])
+    svc.flush()
+    # K=1 and K=2 shapes never share a dispatch.
+    assert sorted(map(tuple, seen)) == [(1,), (2,)]
+
+
+def test_shared_key_shapes_get_their_own_bucket():
+    seen = []
+
+    def device(sets):
+        seen.append(len(sets))
+        return batch_ok(sets)
+
+    svc, clock = make_service(device_verify=device)
+    # Two wide shared-key messages (same key list) + one different wide
+    # list: the fingerprint keeps them apart so the backend's shared-key
+    # fast path sees a pure batch.
+    svc.submit("sync_contribution", [FakeSet(n_keys=128, key_id=0)])
+    svc.submit("sync_contribution", [FakeSet(n_keys=128, key_id=0)])
+    svc.submit("sync_contribution", [FakeSet(n_keys=128, key_id=999)])
+    svc.flush()
+    assert sorted(seen) == [1, 2]
+
+
+def test_wide_aggregates_share_one_bucket():
+    seen = []
+
+    def device(sets):
+        seen.append(len(sets))
+        return batch_ok(sets)
+
+    svc, clock = make_service(device_verify=device)
+    # A wide aggregate's signing_keys are the per-message subset its
+    # aggregation bits select — essentially unique per message.  They
+    # must still batch by padded K: only the sync-contribution
+    # shared-key class is fingerprint-separated.
+    svc.submit("aggregate", [FakeSet(n_keys=100, key_id=0)])
+    svc.submit("aggregate", [FakeSet(n_keys=100, key_id=500)])
+    svc.submit("aggregate", [FakeSet(n_keys=100, key_id=1000)])
+    svc.flush()
+    assert seen == [3]
+
+
+def test_drained_buckets_are_pruned():
+    svc, clock = make_service(device_verify=batch_ok)
+    for n in (1, 2, 4, 8):
+        svc.submit("attestation", [FakeSet(n_keys=n)])
+    svc.submit("aggregate", [FakeSet(n_keys=100)])
+    svc.flush()
+    assert svc.pending() == 0
+    # Bucket keys are unbounded (one per shape ever seen) and every
+    # submit scans them under the lock — drained entries must go.
+    assert svc._buckets == {}
+
+
+def test_ewma_excludes_backoff_from_dispatch_estimate():
+    calls = {"n": 0}
+
+    def device(sets):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return True
+
+    clock = FakeClock()
+    sleeper = SleepRecorder(clock)  # backoff sleeps advance the clock
+    svc, _ = make_service(clock=clock, device_verify=device, retries=1,
+                          sleep=sleeper, slo_ms=10_000.0)
+    svc.submit("attestation", [FakeSet()])
+    svc.flush()
+    assert sleeper.calls  # a retry backoff actually happened
+    # The envelope-call wall time included the backoff sleep; the
+    # batching estimate must reflect only the successful attempt (~0 on
+    # the fake clock) or one fault burst collapses post-outage batches
+    # to singletons.
+    assert svc._ewma_dispatch_s == 0.0
+
+
+def test_flush_waits_for_inflight_dispatches():
+    release = threading.Event()
+    entered = threading.Event()
+    results = []
+
+    def device(sets):
+        entered.set()
+        release.wait(5.0)
+        return True
+
+    svc, clock = make_service(device_verify=device, slo_ms=10_000.0)
+    svc.submit("attestation", [FakeSet()],
+               on_result=lambda ok, path: results.append(ok))
+    t = threading.Thread(target=lambda: svc.pump(force=True), daemon=True)
+    t.start()
+    assert entered.wait(2.0)
+    # The pump thread popped the bucket but the verdict is still owed:
+    # pending() must not read 0 mid-dispatch.
+    assert svc.pending() == 1
+    flushed = threading.Event()
+    f = threading.Thread(
+        target=lambda: (svc.flush(), flushed.set()), daemon=True)
+    f.start()
+    assert not flushed.wait(0.2)  # flush waits on the in-flight message
+    release.set()
+    assert flushed.wait(2.0)
+    assert results == [True]
+    assert svc.pending() == 0
+
+
+def test_batch_failure_splits_per_message():
+    results = {}
+
+    def device(sets):
+        return batch_ok(sets)
+
+    svc, clock = make_service(device_verify=device)
+    for i, valid in enumerate([True, False, True]):
+        svc.submit("attestation", [FakeSet(valid=valid)],
+                   on_result=lambda ok, path, i=i: results.__setitem__(
+                       i, (ok, path)))
+    svc.flush()
+    assert results[0][0] is True
+    assert results[1][0] is False
+    assert results[2][0] is True
+    st = svc.stats()
+    assert st["splits"] == 1
+    assert st["verified"] == 2 and st["rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Overload shedding
+# ---------------------------------------------------------------------------
+
+
+def test_attestation_overload_sheds_oldest_first():
+    shed = []
+    svc, clock = make_service(device_verify=batch_ok,
+                              max_pending_attestations=4)
+    for i in range(6):
+        svc.submit("attestation", [FakeSet()],
+                   on_result=lambda ok, path, i=i:
+                   shed.append(i) if path == "shed" else None)
+        clock.advance(0.001)
+    assert svc.stats()["shed"] == 2
+    assert shed == [0, 1]  # oldest degrade first
+    svc.flush()
+    assert svc.stats()["verified"] == 4
+
+
+def test_aggregates_never_shed_attestations_degrade_instead():
+    paths = {"agg_shed": 0, "att_shed": 0}
+    svc, clock = make_service(device_verify=batch_ok,
+                              max_pending_attestations=100,
+                              max_pending_total=4)
+    for _ in range(4):
+        svc.submit("attestation", [FakeSet()],
+                   on_result=lambda ok, path: paths.__setitem__(
+                       "att_shed", paths["att_shed"] + (path == "shed")))
+    # Total is at cap: aggregates still enter; attestations are evicted.
+    for _ in range(3):
+        assert svc.submit("aggregate", [FakeSet()],
+                          on_result=lambda ok, path: paths.__setitem__(
+                              "agg_shed",
+                              paths["agg_shed"] + (path == "shed")))
+    assert paths["agg_shed"] == 0
+    assert paths["att_shed"] == 3
+    # An attestation arriving over a full total evicts the OLDEST
+    # pending attestation and is itself admitted — shedding the
+    # newcomer would invert the decay policy (fresh outranks stale).
+    assert svc.submit("attestation", [FakeSet()],
+                      on_result=lambda ok, path: None)
+    assert paths["att_shed"] == 4
+    svc.flush()
+    st = svc.stats()
+    assert st["shed"] == 4
+    assert st["verified"] == 4  # newest attestation + 3 aggregates
+
+
+def test_attestation_shed_at_door_when_backlog_is_never_shed():
+    svc, clock = make_service(device_verify=batch_ok, max_pending_total=3)
+    for _ in range(3):
+        svc.submit("aggregate", [FakeSet()])
+    # Nothing sheddable in the backlog (all never-shed kinds): the
+    # incoming attestation is the only degradable message in sight.
+    assert not svc.submit("attestation", [FakeSet()])
+    svc.flush()
+    st = svc.stats()
+    assert st["shed"] == 1 and st["verified"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Faults: determinism + zero-loss degradation
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injector_is_deterministic():
+    def run():
+        inj = FaultInjector(seed=42, plans={
+            "bls_dispatch": FaultPlan(fail_rate=0.3)})
+        outcomes = []
+        for _ in range(50):
+            try:
+                inj.check("bls_dispatch")
+                outcomes.append(0)
+            except InjectedFault:
+                outcomes.append(1)
+        return outcomes
+
+    a, b = run(), run()
+    assert a == b
+    assert sum(a) > 0
+
+
+def test_fault_outage_window_is_exact():
+    inj = FaultInjector(seed=0, plans={
+        "bls_dispatch": FaultPlan(outage=(3, 7))})
+    outcomes = []
+    for _ in range(10):
+        try:
+            inj.check("bls_dispatch")
+            outcomes.append(0)
+        except InjectedFault:
+            outcomes.append(1)
+    assert outcomes == [0, 0, 0, 1, 1, 1, 1, 0, 0, 0]
+
+
+def test_burst_schedule_deterministic_and_bursty():
+    a = burst_schedule(50, 100.0, burst_every=10, burst_size=5, seed=3)
+    b = burst_schedule(50, 100.0, burst_every=10, burst_size=5, seed=3)
+    assert a == b
+    assert len(a) >= 50
+    # Bursts create exact-duplicate arrival instants.
+    assert len(set(a)) < len(a)
+
+
+def test_zero_loss_under_injected_outage_with_recovery():
+    """The acceptance-criterion shape in miniature: sustained outage →
+    breaker trips → host fallback carries traffic → probe recloses →
+    device resumes; every valid message verifies."""
+    inj = FaultInjector(seed=1, plans={
+        "bls_dispatch": FaultPlan(outage=(2, 8))})
+    results = []
+    clock = FakeClock()
+    svc, _ = make_service(clock=clock, device_verify=batch_ok,
+                          faults=inj, retries=1, breaker_threshold=3,
+                          probe_cooldown_s=0.5, max_batch=2)
+    n = 30
+    for i in range(n):
+        svc.submit("attestation", [FakeSet()],
+                   on_result=lambda ok, path: results.append((ok, path)))
+        clock.advance(0.2)  # every message is past its SLO deadline
+        svc.pump(force=True)
+        clock.advance(0.2)  # let the probe cooldown expire between sends
+    svc.flush()
+    assert len(results) == n
+    assert all(ok for ok, _ in results), "a valid message was lost"
+    paths = {p for _, p in results}
+    assert "host" in paths, "outage never degraded to host"
+    assert "device" in paths or "probe" in paths
+    env = svc.envelope.snapshot()
+    assert env["breaker"]["trips"] >= 1
+    assert env["breaker"]["recoveries"] >= 1
+    assert env["breaker"]["state"] == "closed", "device never resumed"
+    st = svc.stats()
+    assert st["rejected"] == 0 and st["shed"] == 0
+
+
+def test_h2d_stall_site_reaches_staged_executor():
+    inj = FaultInjector(seed=0, plans={
+        "h2d": FaultPlan(fail_first=1)})
+    svc, clock = make_service(device_verify=batch_ok, faults=inj)
+    ok = {}
+    svc.submit("attestation", [FakeSet()],
+               on_result=lambda o, p: ok.setdefault("r", o))
+    svc.flush()
+    # The injected staging failure fell back to sync staging — the
+    # message still verified.
+    assert ok["r"] is True
+    assert svc.pipeline_stats["fallbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# KZG path
+# ---------------------------------------------------------------------------
+
+
+def test_kzg_envelope_and_da_seam(monkeypatch):
+    from lighthouse_tpu.beacon_chain.data_availability import (
+        DataAvailabilityChecker)
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    calls = []
+
+    def fake_batch(blobs, cms, pfs, setup):
+        calls.append(len(blobs))
+        return True
+
+    clock = FakeClock()
+    da = DataAvailabilityChecker(MINIMAL, None, setup=object(),
+                                 clock=clock)
+    da.verify_batch_fn = fake_batch
+    assert da._verify_batch([b"x", b"y"], [b"c", b"c"], [b"p", b"p"])
+    assert calls == [2]
+
+
+def test_parked_block_ttl_and_cap():
+    from lighthouse_tpu.beacon_chain.data_availability import (
+        DataAvailabilityChecker)
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    clock = FakeClock()
+    da = DataAvailabilityChecker(MINIMAL, None, setup=object(),
+                                 clock=clock)
+    da.hold_executed_block(b"\x01" * 32, "ex1")
+    clock.advance(da.PARKED_BLOCK_TTL_S + 1)
+    # TTL expired: the parked block is gone (re-fetchable later).
+    assert da.peek_executed_block(b"\x01" * 32) is None
+    assert da.pop_executed_block(b"\x01" * 32) is None
+
+    # Count cap: oldest parked blocks evict first.
+    for i in range(da.MAX_PARKED_BLOCKS + 3):
+        da.hold_executed_block(bytes([i]) * 32, f"ex{i}")
+        clock.advance(0.001)
+    assert da.expire_parked() == da.MAX_PARKED_BLOCKS
+    assert da.peek_executed_block(bytes([0]) * 32) is None
+    assert da.peek_executed_block(
+        bytes([da.MAX_PARKED_BLOCKS + 2]) * 32) is not None
+
+    # Within TTL and cap nothing is dropped.
+    assert da.peek_executed_block(bytes([5]) * 32) is not None
+
+
+# ---------------------------------------------------------------------------
+# Engine-API retries
+# ---------------------------------------------------------------------------
+
+
+def test_engine_api_retries_with_backoff_on_dead_engine():
+    from lighthouse_tpu.execution_layer import EngineError
+    from lighthouse_tpu.execution_layer.engine_api import (
+        HttpJsonRpcEngine, JwtAuth)
+    import random as _random
+
+    sleeper = SleepRecorder()
+    # Port 1 on localhost: connection refused instantly.
+    eng = HttpJsonRpcEngine("http://127.0.0.1:1", JwtAuth(b"\x11" * 32),
+                            retries=2, sleep=sleeper,
+                            rng=_random.Random(0))
+    with pytest.raises(EngineError, match="after 3 attempts"):
+        eng.rpc("eth_syncing", [])
+    assert eng.retry_counts["eth_syncing"] == 2
+    assert len(sleeper.calls) == 2
+    assert sleeper.calls[1] > sleeper.calls[0] * 0.5  # growing backoff
+    from lighthouse_tpu.common.metrics import REGISTRY
+    assert REGISTRY.counter("engine_api_retries_total").value >= 2
+
+
+def test_ensure_verification_service_rejects_late_kwargs():
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls as B
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    from lighthouse_tpu.beacon_chain.verification_service import (
+        uninstall_global_envelope)
+
+    prev_backend, prev_wrapper = B.get_backend(), B._dispatch_wrapper
+    B.set_backend("fake")
+    # Hard-reset the process-global refcount: earlier tests' un-closed
+    # nodes may still hold installs.
+    uninstall_global_envelope()
+    try:
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                            genesis_state=h.state.copy(),
+                            genesis_block_root=hdr.tree_hash_root(),
+                            preset=h.preset, spec=h.spec, T=h.T)
+        svc = chain.ensure_verification_service(slo_ms=50.0)
+        assert chain.ensure_verification_service() is svc  # no-kw: fine
+        # Late config kwargs would be silently dropped — they raise.
+        with pytest.raises(ValueError, match="slo_ms"):
+            chain.ensure_verification_service(slo_ms=10.0)
+        # Teardown pair: DA hook detached, envelope refcount dropped.
+        chain.release_verification_service()
+        assert chain.verification_service is None
+        assert chain.data_availability.verify_batch_fn is None
+        assert B._dispatch_wrapper is None
+    finally:
+        B.set_backend(prev_backend.name)
+        B.set_dispatch_wrapper(prev_wrapper)
+
+
+def test_staging_failure_completes_messages_not_deadlocks():
+    svc, clock = make_service(device_verify=batch_ok)
+    results = []
+    for _ in range(2):
+        svc.submit("attestation", [FakeSet()],
+                   on_result=lambda ok, path: results.append((ok, path)))
+
+    def broken_prep(item):
+        raise RuntimeError("staging machinery broke")
+
+    # prep raising escapes StagedExecutor.map with the popped
+    # submissions uncompleted: they must still get (error) verdicts or
+    # _inflight leaks and flush() deadlocks on the drain condition.
+    svc._prep_bucket = broken_prep
+    svc.flush()
+    assert results == [(False, "error"), (False, "error")]
+    assert svc.pending() == 0
+    assert svc.stats()["in_flight"] == 0
+
+
+def test_observe_if_fresh_is_atomic():
+    from lighthouse_tpu.beacon_chain.observed import ObservedAttesters
+
+    obs = ObservedAttesters()
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def run():
+        barrier.wait()
+        if obs.observe(5, 7):
+            wins.append(1)
+
+    threads = [threading.Thread(target=run) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Exactly ONE concurrent caller may win the observe — the streaming
+    # dedup relies on this to keep duplicate gossip copies out of the
+    # op pool when two pump threads complete at once.
+    assert len(wins) == 1
+    assert obs.has_attested(5, 7)
+
+
+def test_streaming_duplicate_copies_register_once():
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.beacon_chain.verification_service import (
+        uninstall_global_envelope)
+    from lighthouse_tpu.crypto import bls as B
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    prev_backend, prev_wrapper = B.get_backend(), B._dispatch_wrapper
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                            genesis_state=h.state.copy(),
+                            genesis_block_root=hdr.tree_hash_root(),
+                            preset=h.preset, spec=h.spec, T=h.T)
+        for _ in range(2):
+            signed = h.build_block()
+            h.apply_block(signed)
+            chain.per_slot_task(int(signed.message.slot))
+            chain.process_block(signed)
+        chain.ensure_verification_service(slo_ms=60_000.0)
+        atts = h.attestations_for_slot(h.state, int(h.state.slot) - 1)
+        chain.per_slot_task(int(h.state.slot) + 1)
+        # Mesh redundancy: every attestation arrives TWICE inside the
+        # SLO window; both copies pass the submit-time first-seen peek
+        # (attesters only record post-verify), so the completion-time
+        # re-check must drop the loser or the op pool doubles.
+        chain.stream_attestation_batch(list(atts) + list(atts))
+        chain.verification_service.flush()
+        assert chain.op_pool.num_attestations() == len(atts)
+    finally:
+        uninstall_global_envelope()
+        B.set_backend(prev_backend.name)
+        B.set_dispatch_wrapper(prev_wrapper)
+
+
+# ---------------------------------------------------------------------------
+# Processor integration (drain contract)
+# ---------------------------------------------------------------------------
+
+
+def test_run_until_idle_flushes_streaming_service():
+    from lighthouse_tpu.network.beacon_processor import BeaconProcessor
+
+    svc, clock = make_service(device_verify=batch_ok, slo_ms=60_000.0)
+    proc = BeaconProcessor()
+    proc.verification_service = svc
+    got = {}
+    svc.submit("attestation", [FakeSet()],
+               on_result=lambda ok, path: got.setdefault("ok", ok))
+    # Nothing is SLO-due, but the synchronous drain contract still
+    # completes everything before returning.
+    n = proc.run_until_idle()
+    assert n >= 1
+    assert got.get("ok") is True
+    assert svc.pending() == 0
+
+
+def test_idle_pump_runs_off_manager_thread():
+    from lighthouse_tpu.network.beacon_processor import (
+        BeaconProcessor, WorkEvent, WorkType)
+
+    gate = threading.Event()
+    started = threading.Event()
+
+    class WedgedService:
+        def pending(self):
+            return 1
+
+        def has_due_work(self):
+            return True
+
+        def pump(self):
+            started.set()
+            gate.wait(5.0)
+
+    proc = BeaconProcessor()
+    proc.verification_service = WedgedService()
+    proc.start()
+    try:
+        assert started.wait(2.0)  # idle tick launched the pump
+        done = threading.Event()
+        proc.submit(WorkEvent(WorkType.GossipBlock, None,
+                              lambda _p: done.set()))
+        # A wedged pump (device outage riding the envelope's deadline/
+        # backoff) must not stall work-event dispatch: the pump runs on
+        # a worker thread, not the manager loop.
+        assert done.wait(2.0)
+    finally:
+        gate.set()
+        proc.stop()
+
+
+def test_global_envelope_passthrough_for_non_tpu_backends():
+    from lighthouse_tpu.beacon_chain.verification_service import (
+        _global_dispatch)
+    from lighthouse_tpu.crypto import bls as B
+
+    class FakeBackend:
+        name = "fake"
+
+        def verify_signature_sets(self, sets):
+            return "untouched"
+
+    assert _global_dispatch(FakeBackend(), []) == "untouched"
+
+
+# ---------------------------------------------------------------------------
+# Review hardening (PR 7): self-pumping ingress, watchdog reuse,
+# weak breaker registry, global-envelope uninstall
+# ---------------------------------------------------------------------------
+
+
+def test_self_pumping_ingress_dispatches_without_external_pump():
+    """Sustained load never sees an idle tick: a full bucket (and an
+    SLO-due head on a later submit) must dispatch from submit() itself
+    — production auto_pump=True wiring."""
+    seen = []
+
+    def device(sets):
+        seen.append(len(sets))
+        return batch_ok(sets)
+
+    clock = FakeClock()
+    svc = VerificationService(
+        slo_ms=100.0, max_batch=4, retries=0, breaker_threshold=3,
+        seed=0, device_verify=device, clock=clock,
+        sleep=SleepRecorder(clock))
+    svc.envelope.deadline_s = None
+    done = {}
+    for i in range(4):  # 4th submit fills the bucket → self-dispatch
+        svc.submit("attestation", [FakeSet()],
+                   on_result=lambda ok, p, i=i: done.setdefault(i, ok))
+    assert seen == [4] and svc.pending() == 0
+    assert all(done[i] for i in range(4))
+    # SLO pressure path: one stale message + one fresh arrival → the
+    # fresh submit() notices the stale head is due and dispatches BOTH.
+    svc.submit("attestation", [FakeSet()])
+    clock.advance(0.101)  # stale head past its SLO deadline
+    svc.submit("attestation", [FakeSet()])
+    assert seen == [4, 2] and svc.pending() == 0
+
+
+def test_watchdog_pool_reuses_threads_and_abandons_wedged():
+    import threading as T
+
+    idents = []
+
+    def quick():
+        idents.append(T.get_ident())
+        return True
+
+    env = ResilienceEnvelope("wd", deadline_s=1.0, retries=0,
+                             breaker_threshold=10)
+    for _ in range(3):
+        out, path = env.call(quick, None, ())
+        assert out is True and path == "device"
+    assert len(set(idents)) == 1, "watchdog thread was not reused"
+
+    # A wedged dispatch is abandoned; the NEXT call gets a fresh worker
+    # and still completes.
+    release = T.Event()
+
+    def wedged():
+        idents.append(T.get_ident())
+        release.wait(5.0)
+        return True
+
+    env.deadline_s = 0.05
+    out, path = env.call(wedged, lambda: "host", ())
+    assert (out, path) == ("host", "host")
+    env.deadline_s = 1.0
+    out, path = env.call(quick, None, ())
+    assert out is True
+    assert idents[-1] != idents[-2], "abandoned worker was reused"
+    release.set()
+
+
+def test_breaker_registry_is_weak():
+    import gc
+
+    from lighthouse_tpu.beacon_chain import verification_service as V
+
+    env = ResilienceEnvelope("weakreg", retries=0, breaker_threshold=1)
+    env.call(lambda: (_ for _ in ()).throw(RuntimeError("x")),
+             lambda: "host", ())
+    assert V.any_breaker_open()
+    name = env.breaker.registered_name
+    assert name in V.breaker_status()
+    del env
+    gc.collect()
+    # The dead service's tripped breaker no longer pollutes attribution.
+    assert name not in V.breaker_status()
+    assert not V.any_breaker_open()
+
+
+def test_global_envelope_install_uninstall_roundtrip():
+    from lighthouse_tpu.beacon_chain.verification_service import (
+        install_global_envelope, uninstall_global_envelope)
+    from lighthouse_tpu.crypto import bls as B
+
+    prev = B._dispatch_wrapper
+    try:
+        assert install_global_envelope()
+        assert B._dispatch_wrapper is not None
+        uninstall_global_envelope()
+        assert B._dispatch_wrapper is None
+        from lighthouse_tpu.beacon_chain import verification_service as V
+        assert V._GLOBAL_ENVELOPE is None
+    finally:
+        B.set_dispatch_wrapper(prev)
+
+
+def test_global_envelope_release_is_refcounted():
+    from lighthouse_tpu.beacon_chain.verification_service import (
+        install_global_envelope,
+        release_global_envelope,
+        uninstall_global_envelope,
+    )
+    from lighthouse_tpu.crypto import bls as B
+
+    prev = B._dispatch_wrapper
+    try:
+        # Hard-reset first: earlier tests' un-closed nodes may hold
+        # install refcounts (the count is process-global).
+        uninstall_global_envelope()
+        assert install_global_envelope()
+        assert install_global_envelope()  # second node, same wrapper
+        release_global_envelope()
+        assert B._dispatch_wrapper is not None  # one holder left
+        release_global_envelope()
+        assert B._dispatch_wrapper is None      # last release detaches
+    finally:
+        uninstall_global_envelope()
+        B.set_dispatch_wrapper(prev)
