@@ -547,6 +547,118 @@ def _epoch_transition_bench() -> dict:
     }
 
 
+def _fork_choice_bench() -> dict:
+    """Device fork choice (ISSUE 8): whole-slot score-delta application +
+    find_head at mainnet-shaped widths — {2^14, 2^18, 2^21} validators ×
+    {1k, 16k} unfinalized nodes.  Three engines over IDENTICAL state: the
+    host ProtoArray (per-node python walk, the oracle), the columnar
+    numpy engine (masked vector step per tree level), and the fused
+    jitted device kernel (segment-sum + level-scheduled propagation in
+    one XLA program).  Each timed round re-votes 1/32 of the registry
+    (one slot's worth of latest-message churn) and runs
+    compute_deltas → apply_score_changes → find_head.  Host rows never
+    need a chip; the device sub-rows degrade to an error note on a dead
+    backend (rc stays 0)."""
+    from lighthouse_tpu.fork_choice import DeviceProtoArrayForkChoice
+    from lighthouse_tpu.fork_choice.proto_array import ZERO_ROOT
+
+    out: dict = {}
+    heads_agree = True
+    runs = 3
+
+    def build_tree(n_nodes: int, rng,
+                   shape: str = "bushy") -> DeviceProtoArrayForkChoice:
+        """``bushy``: uniform random parents (healthy forking, depth
+        ~2·ln n — the level sweep's home turf).  ``chain``: each block
+        extends the last (long non-finality, depth = n — the adaptive
+        dispatch's walk arm)."""
+        dev = DeviceProtoArrayForkChoice(engine="numpy")
+        roots = [b"\x00" * 4 + b"\xfc" * 28]
+        dev.on_block(slot=0, root=roots[0], parent_root=b"\x00" * 32,
+                     state_root=roots[0], justified_epoch=1,
+                     justified_root=roots[0], finalized_epoch=1,
+                     finalized_root=roots[0])
+        for i in range(1, n_nodes):
+            r = int(i).to_bytes(4, "little") + b"\xfc" * 28
+            parent = roots[-1] if shape == "chain" \
+                else roots[int(rng.integers(len(roots)))]
+            dev.on_block(slot=i, root=r, parent_root=parent,
+                         state_root=r, justified_epoch=1,
+                         justified_root=roots[0], finalized_epoch=1,
+                         finalized_root=roots[0])
+            roots.append(r)
+        return dev
+
+    def round_trip(pa, anchor, balances, rng, nv, epoch):
+        # one slot of latest-message churn: 1/32 of the registry re-votes
+        k = max(nv // 32, 1)
+        vals = rng.integers(0, nv, k)
+        target = int(rng.integers(len(pa.indices)))
+        root = int(target).to_bytes(4, "little") + b"\xfc" * 28
+        if root not in pa.indices:
+            root = anchor
+        pa.process_attestation_batch([(vals, root, epoch)])
+        t0 = time.perf_counter()
+        deltas = pa.compute_deltas(balances)
+        pa.apply_score_changes(deltas, (1, anchor), (1, anchor),
+                               ZERO_ROOT, 0, 10_000_000)
+        head = pa.find_head(anchor, 10_000_000)
+        return (time.perf_counter() - t0) * 1e3, head
+
+    shapes = [("bushy", 10, "1k"), ("bushy", 14, "16k"),
+              ("chain", 10, "1k_chain"), ("chain", 14, "16k_chain")]
+    for shape, n_log, n_label in shapes:
+        n_nodes = 1 << n_log
+        base = build_tree(n_nodes, np.random.default_rng(7), shape)
+        anchor = b"\x00" * 4 + b"\xfc" * 28
+        # seed votes: every validator has a latest message.  Chain rows
+        # run one validator width — they exist to pin the topology axis
+        # (the adaptive walk arm), not to re-sweep the validator axis.
+        for v_log in ((18,) if shape == "chain" else (14, 18, 21)):
+            nv = 1 << v_log
+            tag = f"v2e{v_log}_n{n_label}"
+            rng = np.random.default_rng(9)
+            seed_vals = np.arange(nv)
+            cols = DeviceProtoArrayForkChoice.from_host(base.to_host(),
+                                                        engine="numpy")
+            for chunk in np.array_split(seed_vals, 64):
+                t = int(rng.integers(n_nodes))
+                cols.process_attestation_batch(
+                    [(chunk, int(t).to_bytes(4, "little") + b"\xfc" * 28,
+                      1)])
+            balances = np.full(nv, 32 * 10**9, np.uint64)
+            host = cols.to_host()
+            engines = [("columnar", cols), ("host", host)]
+            try:
+                from lighthouse_tpu.fork_choice.device_proto_array import (
+                    warmup)
+                if shape != "chain":
+                    # chain depth exceeds the jit depth guard: the device
+                    # engine serves those rounds from its host fallback,
+                    # so there is no kernel shape to pre-lower
+                    warmup(n_nodes, nv)
+                dev = DeviceProtoArrayForkChoice.from_host(host,
+                                                           engine="jit")
+                engines.append(("device", dev))
+            except Exception as e:
+                out["fork_choice_device_error"] = \
+                    f"{type(e).__name__}: {e}"
+            heads = {}
+            for name, pa in engines:
+                erng = np.random.default_rng(11)
+                ts = []
+                for r in range(runs):
+                    ms, head = round_trip(pa, anchor, balances, erng, nv,
+                                          epoch=2 + r)
+                    ts.append(ms)
+                heads[name] = head
+                out[f"fork_choice_{name}_ms_{tag}"] = round(min(ts), 2)
+            if len(set(heads.values())) != 1:
+                heads_agree = False
+    out["fork_choice_heads_agree"] = heads_agree
+    return out
+
+
 def _op_pool_bench() -> dict:
     """BASELINE row 5: max-cover packing over 100k pooled attestations."""
     from lighthouse_tpu.op_pool import bench_pack_attestations
@@ -808,6 +920,7 @@ _ROWS = [
      "state_root_2e%d" % STATE_LOG2, True),
     ("state_device", _device_resident_state_root_bench,
      "state_root_device_resident", True),
+    ("fork_choice", _fork_choice_bench, "fork_choice_apply", False),
     ("op_pool", _op_pool_bench, "op_pool_pack_100k", False),
     ("slasher", _slasher_bench, "slasher_span_update_1m", False),
     ("block", _block_transition_bench, "block_transition_128att", False),

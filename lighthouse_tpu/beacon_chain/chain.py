@@ -106,6 +106,7 @@ class BeaconChain:
         self.observed_aggregators = ObservedAggregators()
         self.observed_block_producers = ObservedBlockProducers()
         self.payload_verifier = None  # execution-layer seam
+        self.slasher = None  # opt-in: attach_slasher()
         self.sync_message_pool = SyncMessagePool(preset)
         self.event_bus = EventBus()
         self.validator_monitor = None  # opt-in: set a ValidatorMonitor
@@ -197,6 +198,7 @@ class BeaconChain:
         chain.observed_aggregators = ObservedAggregators()
         chain.observed_block_producers = ObservedBlockProducers()
         chain.payload_verifier = None
+        chain.slasher = None
         chain.sync_message_pool = SyncMessagePool(preset)
         chain.event_bus = EventBus()
         chain.validator_monitor = None
@@ -259,6 +261,7 @@ class BeaconChain:
     def per_slot_task(self, slot: int) -> None:
         """`timer` service hook (`beacon_chain.rs:5322`)."""
         self.fork_choice.on_tick(slot)
+        self._drain_slasher(slot)
         self.observed_attesters.prune(slot // self.preset.SLOTS_PER_EPOCH)
         self.observed_block_producers.prune(slot)
         # Sync votes are only read for the previous slot's aggregate.
@@ -491,8 +494,15 @@ class BeaconChain:
             try:
                 idx, _committee = attesting_indices(state, att, self.preset)
                 resolved.append((int(att.data.slot), idx.tolist()))
-                self.fork_choice.on_attestation(_Indexed(
-                    att.data, idx.tolist()), is_from_block=True)
+                indexed = _Indexed(att.data, idx.tolist())
+                # Slasher BEFORE fork choice: an attestation naming an
+                # unknown head block (orphaned branch — the very shape a
+                # double vote takes) raises below, and must still be
+                # ingested for detection.
+                if self.slasher is not None:
+                    self.slasher.accept_attestation(indexed)
+                self.fork_choice.on_attestation(indexed,
+                                                is_from_block=True)
             except Exception:
                 pass  # block attestations are best-effort for fork choice
         if self.validator_monitor is not None:
@@ -513,8 +523,7 @@ class BeaconChain:
         # Finalization housekeeping: prune pool + migrate store.
         fin_epoch, fin_root = self.fork_choice.finalized_checkpoint
         if fin_root != b"\x00" * 32 and self.fork_choice.contains_block(fin_root):
-            fin_slot = self.fork_choice.proto.nodes[
-                self.fork_choice.proto.indices[fin_root]].slot
+            fin_slot = self.fork_choice.block_slot(fin_root)
             self.store.migrate_to_cold(fin_slot, fin_root)
             for root in [r for r, s in self._states_by_block.items()
                          if int(s.slot) < fin_slot - 1]:
@@ -549,6 +558,56 @@ class BeaconChain:
             # live head, which would break the signature).
             self.lc_period_update = period
 
+    # -- slasher seam --------------------------------------------------------
+
+    def attach_slasher(self, slasher) -> None:
+        """Attach a :class:`~lighthouse_tpu.slasher.Slasher`: verified
+        attestations stream into its ingest queue, and the per-slot task
+        drains detected offences into fork choice — each double-vote's
+        equivocating indices land in the vote buffer and are zeroed in
+        the next batched delta pass (host ``on_attester_slashing``
+        semantics)."""
+        self.slasher = slasher
+
+    def _drain_slasher(self, slot: int) -> None:
+        if self.slasher is None:
+            return
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        try:
+            detections = self.slasher.process_queued(epoch)
+        except Exception:
+            return  # detection is best-effort; never kills the slot timer
+        for det in detections:
+            # Slashing carries the two conflicting indexed attestations —
+            # exactly the on_attester_slashing shape (intersection of
+            # attesting indices loses fork-choice weight forever).
+            try:
+                self.fork_choice.on_attester_slashing(det)
+            except Exception:
+                pass
+
+    # -- EL invalidation (optimistic-sync revert) ----------------------------
+
+    def on_invalid_execution_payload(self, block_root: bytes) -> None:
+        """The execution layer reported INVALID for an optimistically
+        imported payload: invalidate the block and all its descendants in
+        fork choice, re-compute the head off the poisoned branch, and
+        re-pack the op pool against the reverted head state
+        (`beacon_chain.rs process_invalid_execution_payload`)."""
+        if not self.fork_choice.contains_block(block_root):
+            return
+        old_head = self.head.root
+        self.fork_choice.on_invalid_execution_payload(block_root)
+        new_head = self.recompute_head()
+        if new_head != old_head:
+            # Op-pool re-pack: attestations/ops packed for the abandoned
+            # branch re-validate against the reverted head's state (stale
+            # ones drop; survivors re-enter the greedy packer's universe).
+            self.op_pool.prune(self.head.state)
+            self.event_bus.publish("payload_invalidated", {
+                "block": "0x" + bytes(block_root).hex(),
+                "new_head": "0x" + new_head.hex()})
+
     def recompute_head(self) -> bytes:
         """`recompute_head` (`canonical_head.rs`)."""
         head_root = self.fork_choice.get_head()
@@ -582,12 +641,14 @@ class BeaconChain:
         """Post-verification import — fork choice + op pool + event
         stream.  The tail of :meth:`process_attestation_batch`, shared
         with the streaming verification service's completion callback."""
+        indexed = _Indexed(verified.attestation.data,
+                           [int(i) for i in verified.indexed_indices])
         try:
-            self.fork_choice.on_attestation(_Indexed(
-                verified.attestation.data,
-                [int(i) for i in verified.indexed_indices]))
+            self.fork_choice.on_attestation(indexed)
         except Exception:
             pass
+        if self.slasher is not None:
+            self.slasher.accept_attestation(indexed)
         self.op_pool.insert_attestation(verified.attestation,
                                         verified.committee)
         self.event_bus.publish("attestation", {

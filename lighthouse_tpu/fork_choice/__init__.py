@@ -4,6 +4,10 @@ Counterparts of ``consensus/proto_array`` and ``consensus/fork_choice``
 (``/root/reference/consensus/{proto_array,fork_choice}/``).
 """
 
+from .device_proto_array import (
+    DeviceProtoArrayForkChoice,
+    device_fork_choice_enabled,
+)
 from .fork_choice import ForkChoice, ForkChoiceError
 from .proto_array import (
     EXEC_INVALID,
@@ -16,6 +20,7 @@ from .proto_array import (
 
 __all__ = [
     "ForkChoice", "ForkChoiceError", "ProtoArrayForkChoice",
+    "DeviceProtoArrayForkChoice", "device_fork_choice_enabled",
     "ProtoArrayError", "EXEC_VALID", "EXEC_OPTIMISTIC", "EXEC_INVALID",
     "EXEC_IRRELEVANT",
 ]

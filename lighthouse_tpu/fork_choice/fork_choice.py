@@ -17,6 +17,7 @@ import numpy as np
 
 from .proto_array import (
     EXEC_IRRELEVANT,
+    EXEC_OPTIMISTIC,
     ProtoArrayError,
     ProtoArrayForkChoice,
     ZERO_ROOT,
@@ -45,14 +46,25 @@ def _active_balances(state, epoch: int) -> np.ndarray:
     return out
 
 
+def _make_proto(device):
+    """Columnar device proto-array by default; the host walk stays
+    bit-for-bit available as the differential oracle behind
+    ``LIGHTHOUSE_TPU_DEVICE_FORKCHOICE=0`` (or ``device=False``)."""
+    from .device_proto_array import (DeviceProtoArrayForkChoice,
+                                     device_fork_choice_enabled)
+    if device is None:
+        device = device_fork_choice_enabled()
+    return DeviceProtoArrayForkChoice() if device else ProtoArrayForkChoice()
+
+
 class ForkChoice:
     """`ForkChoice` (`fork_choice.rs:244`), single-process flavour."""
 
     def __init__(self, preset, spec, *, genesis_root: bytes,
-                 genesis_state, current_slot: int = 0):
+                 genesis_state, current_slot: int = 0, device=None):
         self.preset = preset
         self.spec = spec
-        self.proto = ProtoArrayForkChoice()
+        self.proto = _make_proto(device)
         self.queued: list[QueuedAttestation] = []
         self.justified_state = genesis_state
         jcp = (int(genesis_state.current_justified_checkpoint.epoch),
@@ -87,11 +99,24 @@ class ForkChoice:
 
     def on_block(self, signed_block, block_root: bytes, state,
                  *, is_timely: bool = False,
-                 execution_status: int = EXEC_IRRELEVANT) -> None:
-        """`fork_choice.rs:748`; ``state`` is the block's post-state."""
+                 execution_status: int = EXEC_IRRELEVANT,
+                 execution_block_hash: bytes = None) -> None:
+        """`fork_choice.rs:748`; ``state`` is the block's post-state.
+
+        A block carrying a live execution payload imports OPTIMISTICALLY
+        by default (`fork_choice.rs` payload_verification_status): the
+        payload is only proven by the EL, so `on_invalid_execution_payload`
+        must be able to revert it later; pre-merge blocks stay IRRELEVANT.
+        """
         block = signed_block.message
         if int(block.slot) > self.current_slot:
             self.current_slot = int(block.slot)
+        if execution_status == EXEC_IRRELEVANT:
+            payload = getattr(block.body, "execution_payload", None)
+            if payload is not None \
+                    and bytes(payload.block_hash) != ZERO_ROOT:
+                execution_status = EXEC_OPTIMISTIC
+                execution_block_hash = bytes(payload.block_hash)
         jcp = (int(state.current_justified_checkpoint.epoch),
                bytes(state.current_justified_checkpoint.root))
         fcp = (int(state.finalized_checkpoint.epoch),
@@ -110,7 +135,8 @@ class ForkChoice:
             state_root=bytes(block.state_root),
             justified_epoch=jcp[0], justified_root=jcp[1],
             finalized_epoch=fcp[0], finalized_root=fcp[1],
-            execution_status=execution_status)
+            execution_status=execution_status,
+            execution_block_hash=execution_block_hash)
 
     # -- attestations --------------------------------------------------------
 
@@ -122,8 +148,7 @@ class ForkChoice:
         block_root = bytes(data.beacon_block_root)
         if block_root not in self.proto.indices:
             raise ForkChoiceError("unknown attestation head block")
-        node = self.proto.nodes[self.proto.indices[block_root]]
-        if node.slot > int(data.slot):
+        if self.proto.slot_of(block_root) > int(data.slot):
             raise ForkChoiceError("attestation to a future block")
         indices = np.asarray(list(indexed_attestation.attesting_indices),
                              dtype=np.int64)
@@ -141,19 +166,21 @@ class ForkChoice:
 
     def _drain_queued(self) -> None:
         """Votes only count from the slot after they were cast
-        (`queued_attestations`, `fork_choice.rs:300-330`)."""
-        keep = []
-        for q in self.queued:
-            if q.slot < self.current_slot:
-                try:
-                    for i in q.indices:
-                        self.proto.process_attestation(
-                            int(i), q.block_root, q.target_epoch)
-                except ProtoArrayError:
-                    pass  # block pruned between queue and drain: stale vote
-            else:
-                keep.append(q)
-        self.queued = keep
+        (`queued_attestations`, `fork_choice.rs:300-330`).  The whole
+        slot's due attestations apply as ONE batch — attestations whose
+        block was pruned between queue and drain drop atomically (the
+        host raised before any mutation for those, so filtering first is
+        bit-identical)."""
+        queued = self.queued  # snapshot: appends race with drain (as
+        # before this was batched); one list is partitioned exactly once
+        due = [q for q in queued if q.slot < self.current_slot]
+        if not due:
+            return
+        self.queued = [q for q in queued if q.slot >= self.current_slot]
+        batch = [(q.indices, q.block_root, q.target_epoch)
+                 for q in due if q.block_root in self.proto.indices]
+        if batch:
+            self.proto.process_attestation_batch(batch)
 
     # -- head ----------------------------------------------------------------
 
@@ -187,3 +214,7 @@ class ForkChoice:
 
     def contains_block(self, root: bytes) -> bool:
         return root in self.proto.indices
+
+    def block_slot(self, root: bytes) -> int:
+        """Slot of a known block (works on both proto-array flavours)."""
+        return self.proto.slot_of(root)

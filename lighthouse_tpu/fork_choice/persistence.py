@@ -69,6 +69,11 @@ def _unpack_arr(buf: memoryview, off: int, dtype) -> tuple[np.ndarray, int]:
 
 def encode_fork_choice(fc: ForkChoice) -> bytes:
     p = fc.proto
+    if hasattr(p, "to_host"):
+        # Columnar proto-array: snapshot through the bit-exact host view
+        # (pending buffered votes merge first) so the blob format is
+        # identical across both flavours — a restart can flip the knob.
+        p = p.to_host()
     out = [_MAGIC]
     out.append(struct.pack("<I", len(p.nodes)))
     out.extend(_pack_node(n) for n in p.nodes)
@@ -132,6 +137,13 @@ def decode_fork_choice(data: bytes, *, preset, spec,
     fc = ForkChoice.__new__(ForkChoice)
     fc.preset = preset
     fc.spec = spec
+    from .device_proto_array import (DeviceProtoArrayForkChoice,
+                                     device_fork_choice_enabled)
+    if device_fork_choice_enabled():
+        # Restore INTO the columnar form (the device path resumes with
+        # weights/best-children/votes exactly where the snapshot left
+        # them — no replay needed).
+        proto = DeviceProtoArrayForkChoice.from_host(proto)
     fc.proto = proto
     fc.justified_state = justified_state
     fc.justified_checkpoint = (fje, fjr)

@@ -116,6 +116,13 @@ class ProtoArrayForkChoice:
         self.nodes.append(node)
         self.indices[root] = idx
 
+    def slot_of(self, root: bytes) -> int:
+        """Slot of a known block (shared API with the columnar twin)."""
+        idx = self.indices.get(bytes(root))
+        if idx is None:
+            raise ProtoArrayError("unknown block")
+        return self.nodes[idx].slot
+
     def process_attestation(self, validator_index: int, block_root: bytes,
                             target_epoch: int) -> None:
         """Latest-message update (`proto_array_fork_choice.rs:370`): keep
@@ -130,6 +137,17 @@ class ProtoArrayForkChoice:
                 or self.votes.next[validator_index] == -1:
             self.votes.next[validator_index] = idx
             self.votes.next_epoch[validator_index] = target_epoch
+
+    def process_attestation_batch(self, batch) -> None:
+        """Whole-slot vote ingest: ``[(indices, block_root, target_epoch),
+        …]``.  The host oracle applies them as the sequential per-validator
+        fold (the definition of correct ordering semantics); the columnar
+        twin overrides this with one vectorized buffer push per
+        attestation."""
+        for indices, block_root, target_epoch in batch:
+            for i in indices:
+                self.process_attestation(int(i), block_root,
+                                         int(target_epoch))
 
     def process_equivocation(self, validator_index: int) -> None:
         """Remove an equivocating validator's weight forever (spec's

@@ -29,7 +29,7 @@ from ..state_transition import signature_sets as sigs
 from ..state_transition.shuffle import shuffle_list
 from ..types.chain_spec import ChainSpec, ForkName
 from ..types.presets import MAINNET, MINIMAL
-from .ef_runner import _epoch_steps
+from .ef_runner import _FcIndexed, _epoch_steps
 from .harness import StateHarness
 
 GEN_FORKS = (ForkName.PHASE0, ForkName.ALTAIR, ForkName.BELLATRIX,
@@ -730,6 +730,240 @@ def _mainnet_harness(fork: ForkName) -> StateHarness:
                         spec=ChainSpec.mainnet().with_forks_at_genesis(fork))
 
 
+# -- fork_choice runner vectors ---------------------------------------------
+
+
+class _FcRecorder:
+    """Drive a HOST-oracle ForkChoice while recording the EF
+    ``fork_choice`` step stream (`cases/fork_choice.rs` layout: anchor
+    state/block + steps.yaml + per-step ssz files).  Every check is the
+    oracle's own answer at generation time — the runner replays them
+    against BOTH the host and columnar paths."""
+
+    def __init__(self, d: str, h: StateHarness):
+        from ..fork_choice import ForkChoice
+
+        self.d = d
+        self.h = h
+        self.steps: list = []
+        state = h.state.copy()
+        hdr = state.latest_block_header.copy()
+        hdr.state_root = state.tree_hash_root()
+        self.genesis_root = hdr.tree_hash_root()
+        body = h.T.body_cls(h.fork_at(0))()
+        anchor = h.T.block_cls(h.fork_at(0))(
+            slot=int(hdr.slot), proposer_index=int(hdr.proposer_index),
+            parent_root=bytes(hdr.parent_root),
+            state_root=bytes(hdr.state_root), body=body)
+        if anchor.tree_hash_root() != self.genesis_root:
+            raise AssertionError("anchor block root != genesis header root")
+        _dump_state(d, "anchor_state", state)
+        _write(os.path.join(d, "anchor_block.ssz"),
+               type(anchor).serialize(anchor))
+        self.fc = ForkChoice(h.preset, h.spec,
+                             genesis_root=self.genesis_root,
+                             genesis_state=state.copy(), device=False)
+        self.states = {self.genesis_root: state}
+        self.genesis_time = int(state.genesis_time)
+
+    def tick(self, slot: int) -> None:
+        self.steps.append(
+            {"tick": self.genesis_time
+             + slot * self.h.spec.seconds_per_slot})
+        self.fc.on_tick(slot)
+
+    def block(self, sb) -> bytes:
+        root = sb.message.tree_hash_root()
+        from ..state_transition.per_slot import state_transition
+        pre = self.states[bytes(sb.message.parent_root)]
+        post = state_transition(
+            pre.copy(), sb, self.h.preset, self.h.spec, self.h.T,
+            strategy=PB.SignatureStrategy.VERIFY_BULK)
+        self.states[root] = post
+        name = "block_0x" + root.hex()[:16]
+        _write(os.path.join(self.d, name + ".ssz"),
+               type(sb).serialize(sb))
+        self.steps.append({"block": name})
+        if int(sb.message.slot) > self.fc.current_slot:
+            self.fc.on_tick(int(sb.message.slot))
+        self.fc.on_block(sb, root, post.copy())
+        return root
+
+    def attestation(self, att) -> None:
+        from ..beacon_chain.attestation_verification import attesting_indices
+        from ..state_transition.per_slot import process_slots
+        name = ("attestation_0x"
+                + att.data.tree_hash_root().hex()[:16])
+        _write(os.path.join(self.d, name + ".ssz"),
+               type(att).serialize(att))
+        self.steps.append({"attestation": name})
+        st = self.states[bytes(att.data.beacon_block_root)]
+        if int(st.slot) < int(att.data.slot):
+            st = process_slots(st.copy(), int(att.data.slot),
+                               self.h.preset, self.h.spec, self.h.T)
+        idx, _c = attesting_indices(st, att, self.h.preset)
+        self.fc.on_attestation(_FcIndexed(att.data, idx.tolist()))
+
+    def attester_slashing(self, slashing) -> None:
+        name = ("attester_slashing_0x"
+                + slashing.tree_hash_root().hex()[:16])
+        _write(os.path.join(self.d, name + ".ssz"),
+               type(slashing).serialize(slashing))
+        self.steps.append({"attester_slashing": name})
+        self.fc.on_attester_slashing(slashing)
+
+    def invalid_payload(self, block_root: bytes) -> None:
+        # Framework extension step (our vectors are self-generated; a
+        # real tarball's on_payload_info steps would map the same way).
+        self.steps.append({"payload_status": {
+            "block_root": "0x" + block_root.hex(), "status": "INVALID"}})
+        self.fc.on_invalid_execution_payload(block_root)
+
+    def checks(self) -> bytes:
+        head = self.fc.get_head()
+        jcp = self.fc.justified_checkpoint
+        fcp = self.fc.finalized_checkpoint
+        self.steps.append({"checks": {
+            "head": {"slot": self.fc.block_slot(head),
+                     "root": "0x" + head.hex()},
+            "justified_checkpoint": {"epoch": jcp[0],
+                                     "root": "0x" + jcp[1].hex()},
+            "finalized_checkpoint": {"epoch": fcp[0],
+                                     "root": "0x" + fcp[1].hex()},
+            "proposer_boost_root":
+                "0x" + self.fc.proposer_boost_root.hex(),
+        }})
+        return head
+
+    def finish(self) -> None:
+        _write_yaml(os.path.join(self.d, "steps.yaml"), self.steps)
+
+    def branch_block(self, state, slot: int, graffiti: bytes,
+                     **build_kw):
+        """Build a signed block on an arbitrary branch state (the harness
+        builds on its live state; swap it in and out)."""
+        saved = self.h.state
+        self.h.state = state.copy()
+        try:
+            sb = self.h.build_block(slot=slot, graffiti=graffiti,
+                                    **build_kw)
+        finally:
+            self.h.state = saved
+        return sb
+
+
+def _branch_attestations(rec: _FcRecorder, block_root: bytes, slot: int):
+    """Committee attestations for ``slot`` naming ``block_root``'s branch
+    as head (built on that branch's post-state, advanced one slot)."""
+    from ..state_transition.per_slot import process_slots
+    st = rec.states[block_root]
+    adv = process_slots(st.copy(), slot + 1, rec.h.preset, rec.h.spec,
+                       rec.h.T)
+    return rec.h.attestations_for_slot(adv, slot)
+
+
+def _gen_fork_choice(root: str, fork: ForkName,
+                     config: str = "minimal") -> None:
+    """fork_choice runner slice: head tracking, a forked vote flip, an
+    equivocation slashing, EL invalidation revert (post-merge forks), and
+    a finality advance — each case's checks are oracle pins."""
+    mainnet = config == "mainnet"
+
+    def case(name: str) -> _FcRecorder:
+        h = _mainnet_harness(fork) if mainnet else _harness(fork)
+        d = _case(root, config, fork, "fork_choice", "get_head",
+                  "pyspec_tests", name)
+        return _FcRecorder(d, h)
+
+    # Mainnet sync committees are 512 keys of pure-python signing per
+    # block — skip the aggregate (empty one is valid), keep vectors cheap.
+    bkw = {"sync_participation": 0.0} if mainnet else {}
+
+    # -- linear chain: head tracks the tip, votes confirm it ---------------
+    rec = case("chain_head_tracks")
+    rec.tick(1)
+    b1 = rec.block(rec.branch_block(rec.h.state, 1, b"\x01" * 32, **bkw))
+    assert rec.checks() == b1
+    rec.tick(2)
+    b2 = rec.block(rec.branch_block(rec.states[b1], 2, b"\x02" * 32, **bkw))
+    assert rec.checks() == b2
+    rec.tick(3)
+    for att in _branch_attestations(rec, b2, 2):
+        rec.attestation(att)
+    assert rec.checks() == b2
+    rec.finish()
+
+    # -- two-branch fork: votes flip the head off the tie-break winner -----
+    rec = case("fork_vote_flip")
+    rec.tick(1)
+    b1 = rec.block(rec.branch_block(rec.h.state, 1, b"\x01" * 32, **bkw))
+    c2a = rec.block(rec.branch_block(rec.states[b1], 2, b"\xaa" * 32,
+                                     **bkw))
+    c2b = rec.block(rec.branch_block(rec.states[b1], 2, b"\xbb" * 32,
+                                     **bkw))
+    rec.tick(3)
+    tie_winner = rec.checks()
+    assert tie_winner in (c2a, c2b)
+    loser = c2b if tie_winner == c2a else c2a
+    flip_atts = _branch_attestations(rec, loser, 2)
+    for att in flip_atts:
+        rec.attestation(att)
+    assert rec.checks() == loser, "votes must flip the head"
+    # -- the voters equivocate: their weight vanishes, tie-break returns --
+    from ..beacon_chain.attestation_verification import attesting_indices
+    from ..state_transition.per_slot import process_slots
+    adv = process_slots(rec.states[loser].copy(), 3, rec.h.preset,
+                        rec.h.spec, rec.h.T)
+    voters: set = set()
+    for att in flip_atts:
+        idx, _c = attesting_indices(adv, att, rec.h.preset)
+        voters.update(int(i) for i in idx)
+    slashing = rec.h.make_attester_slashing(adv, sorted(voters))
+    rec.attester_slashing(slashing)
+    assert rec.checks() == tie_winner, "equivocation must revert the flip"
+    rec.finish()
+
+    if fork >= ForkName.BELLATRIX:
+        # -- EL invalidation: descendants die, head reverts to sibling ----
+        rec = case("invalidation_revert")
+        rec.tick(1)
+        b1 = rec.block(rec.branch_block(rec.h.state, 1, b"\x01" * 32,
+                                        **bkw))
+        c2a = rec.block(rec.branch_block(rec.states[b1], 2, b"\xaa" * 32,
+                                         **bkw))
+        c2b = rec.block(rec.branch_block(rec.states[b1], 2, b"\xbb" * 32,
+                                         **bkw))
+        b3 = rec.block(rec.branch_block(rec.states[c2a], 3, b"\x03" * 32,
+                                        **bkw))
+        rec.tick(4)
+        for att in _branch_attestations(rec, b3, 3):
+            rec.attestation(att)
+        assert rec.checks() == b3
+        rec.invalid_payload(c2a)
+        assert rec.checks() == c2b, "invalidation must revert to sibling"
+        rec.finish()
+
+    if not mainnet:
+        # -- finality advances through imported checkpoints ----------------
+        rec = case("finality_advances")
+        h = rec.h
+        spe = h.preset.SLOTS_PER_EPOCH
+        # Full participation justifies epoch 2 at the slot-3·spe boundary
+        # (the genesis epoch never accumulates enough weighted target).
+        for sb in h.extend_chain(3 * spe + 2):
+            rec.tick(int(sb.message.slot))
+            rec.block(sb)
+        rec.checks()
+        assert rec.fc.justified_checkpoint[0] >= 1, "no justification"
+        rec.finish()
+
+
+def _gen_fork_choice_all(root: str) -> None:
+    for fork in (ForkName.PHASE0, ForkName.CAPELLA):
+        _gen_fork_choice(root, fork, config="minimal")
+    _gen_fork_choice(root, ForkName.CAPELLA, config="mainnet")
+
+
 def _gen_mainnet_slice(root: str) -> None:
     """A mainnet-preset slice (capella) so preset-dependent constants
     (committee sizes, epochs, churn) aren't only exercised on minimal."""
@@ -783,6 +1017,7 @@ def generate(root: str) -> None:
             _gen_shuffling(root, fork)
         _gen_transition(root)
         _gen_mainnet_slice(root)
+        _gen_fork_choice_all(root)
         _gen_bls(root)
     finally:
         B.set_backend(prev)

@@ -467,6 +467,123 @@ def _case_transition(ctx: CaseCtx, handler: str) -> None:
     ctx.expect_post(state)
 
 
+# -- fork_choice runner ------------------------------------------------------
+
+
+class _FcIndexed:
+    def __init__(self, data, indices):
+        self.data = data
+        self.attesting_indices = indices
+
+
+def _run_fork_choice_steps(ctx: CaseCtx, steps, anchor_block, anchor_state,
+                           device: bool) -> list:
+    """Replay one step stream against a ForkChoice instance (host oracle
+    when ``device`` is False, columnar device path when True); returns the
+    head sequence observed at the check steps."""
+    from ..beacon_chain.attestation_verification import attesting_indices
+    from ..fork_choice import ForkChoice
+    from ..state_transition.per_slot import process_slots, state_transition
+
+    anchor_root = anchor_block.tree_hash_root()
+    fc = ForkChoice(ctx.preset, ctx.spec, genesis_root=anchor_root,
+                    genesis_state=anchor_state.copy(), device=device)
+    states = {anchor_root: anchor_state}
+    spt = ctx.spec.seconds_per_slot
+    genesis_time = int(anchor_state.genesis_time)
+    heads = []
+    for step in steps:
+        if "tick" in step:
+            fc.on_tick((int(step["tick"]) - genesis_time) // spt)
+        elif "block" in step:
+            raw = ctx.ssz(step["block"] + ".ssz")
+            sb = ctx.T.signed_block_cls(ctx.fork).deserialize(raw)
+            pre = states[bytes(sb.message.parent_root)]
+            post = state_transition(
+                pre.copy(), sb, ctx.preset, ctx.spec, ctx.T,
+                strategy=PB.SignatureStrategy.VERIFY_BULK)
+            root = sb.message.tree_hash_root()
+            states[root] = post
+            if int(sb.message.slot) > fc.current_slot:
+                fc.on_tick(int(sb.message.slot))
+            fc.on_block(sb, root, post)
+        elif "attestation" in step:
+            raw = ctx.ssz(step["attestation"] + ".ssz")
+            att = ctx.T.Attestation.deserialize(raw)
+            st = states[bytes(att.data.beacon_block_root)]
+            if int(st.slot) < int(att.data.slot):
+                st = process_slots(st.copy(), int(att.data.slot),
+                                   ctx.preset, ctx.spec, ctx.T)
+            idx, _c = attesting_indices(st, att, ctx.preset)
+            fc.on_attestation(_FcIndexed(att.data, idx.tolist()))
+        elif "attester_slashing" in step:
+            raw = ctx.ssz(step["attester_slashing"] + ".ssz")
+            slashing = ctx.T.AttesterSlashing.deserialize(raw)
+            fc.on_attester_slashing(slashing)
+        elif "payload_status" in step:
+            info = step["payload_status"]
+            root = bytes.fromhex(info["block_root"].removeprefix("0x"))
+            if info["status"] == "INVALID":
+                fc.on_invalid_execution_payload(root)
+            else:
+                fc.on_valid_execution_payload(root)
+        elif "checks" in step:
+            head = fc.get_head()
+            heads.append(head)
+            c = step["checks"]
+            path = "device" if device else "host"
+            if "head" in c:
+                want = bytes.fromhex(c["head"]["root"].removeprefix("0x"))
+                if head != want:
+                    raise EfTestFailure(
+                        f"{ctx.case_dir} [{path}]: head {head.hex()} != "
+                        f"{want.hex()}")
+                if fc.block_slot(head) != int(c["head"]["slot"]):
+                    raise EfTestFailure(
+                        f"{ctx.case_dir} [{path}]: head slot mismatch")
+            for key, got in (("justified_checkpoint",
+                              fc.justified_checkpoint),
+                             ("finalized_checkpoint",
+                              fc.finalized_checkpoint)):
+                if key in c:
+                    want = (int(c[key]["epoch"]), bytes.fromhex(
+                        c[key]["root"].removeprefix("0x")))
+                    if got != want:
+                        raise EfTestFailure(
+                            f"{ctx.case_dir} [{path}]: {key} {got} != "
+                            f"{want}")
+            if "proposer_boost_root" in c:
+                want = bytes.fromhex(
+                    c["proposer_boost_root"].removeprefix("0x"))
+                if fc.proposer_boost_root != want:
+                    raise EfTestFailure(
+                        f"{ctx.case_dir} [{path}]: boost root mismatch")
+        else:
+            raise EfTestFailure(f"{ctx.case_dir}: unknown step {step}")
+    return heads
+
+
+def _case_fork_choice(ctx: CaseCtx, handler: str) -> None:
+    """EF fork_choice case: replay the step stream against BOTH the host
+    proto-array and the columnar device path; every checks step must pass
+    on each, and the two head sequences must be identical."""
+    anchor_state = ctx.state("anchor_state")
+    raw = ctx.ssz("anchor_block.ssz")
+    if anchor_state is None or raw is None:
+        raise EfTestFailure(f"{ctx.case_dir}: incomplete fork_choice case")
+    anchor_block = ctx.T.block_cls(ctx.fork).deserialize(raw)
+    steps = ctx.yaml("steps.yaml")
+    host_heads = _run_fork_choice_steps(ctx, steps, anchor_block,
+                                        anchor_state, device=False)
+    dev_heads = _run_fork_choice_steps(ctx, steps, anchor_block,
+                                       anchor_state, device=True)
+    if host_heads != dev_heads:
+        raise EfTestFailure(
+            f"{ctx.case_dir}: host/device head divergence "
+            f"({[h.hex()[:8] for h in host_heads]} vs "
+            f"{[h.hex()[:8] for h in dev_heads]})")
+
+
 # -- rewards runner ----------------------------------------------------------
 
 class Deltas(Container):
@@ -512,6 +629,7 @@ _RUNNERS: Dict[str, Callable] = {
     "bls": _case_bls,
     "transition": _case_transition,
     "rewards": _case_rewards,
+    "fork_choice": _case_fork_choice,
 }
 
 

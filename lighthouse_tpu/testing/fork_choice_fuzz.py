@@ -1,0 +1,257 @@
+"""Randomized fork-choice differential fuzzer.
+
+Drives the host :class:`~lighthouse_tpu.fork_choice.ProtoArrayForkChoice`
+(the bit-for-bit oracle) and the columnar
+:class:`~lighthouse_tpu.fork_choice.DeviceProtoArrayForkChoice` through one
+shuffled interleaving of
+
+    block inserts (random parents, disconnected roots, FFG mismatches) ·
+    attestation batches (random subsets/targets/epochs, stale re-votes) ·
+    equivocations · payload invalidation/validation · pruning ·
+    head rounds (random balances, proposer boost, checkpoint flips)
+
+and asserts the full observable state is identical after every head round:
+the head itself (or the identical error), per-node weights, best-child/
+best-descendant links, the latest-message vote columns, persisted
+balances, and the index map.  Used by both
+``scripts/validate_fork_choice.py`` and the quick-tier differential tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..fork_choice.device_proto_array import DeviceProtoArrayForkChoice
+from ..fork_choice.proto_array import (
+    EXEC_OPTIMISTIC,
+    ProtoArrayError,
+    ProtoArrayForkChoice,
+    ZERO_ROOT,
+)
+
+
+def _root(i: int) -> bytes:
+    return int(i).to_bytes(4, "little") + b"\xab" * 28
+
+
+class MismatchError(AssertionError):
+    pass
+
+
+def _call_both(host_fn, dev_fn, label: str):
+    """Run the same op on both sides; identical results OR identical
+    errors are required."""
+    he = de = None
+    hr = dr = None
+    try:
+        hr = host_fn()
+    except ProtoArrayError as e:
+        he = str(e)
+    try:
+        dr = dev_fn()
+    except ProtoArrayError as e:
+        de = str(e)
+    if he != de:
+        raise MismatchError(f"{label}: host error {he!r} vs device {de!r}")
+    return hr, dr, he
+
+
+class DifferentialRun:
+    """One seeded interleaving.  ``engine`` selects the columnar engine
+    ("numpy" or "jit"); mismatches raise :class:`MismatchError`."""
+
+    def __init__(self, seed: int, *, n_validators: int = 64,
+                 engine: str = "numpy", prune_threshold: int = 4,
+                 max_nodes: Optional[int] = None,
+                 chain_bias: float = 0.0,
+                 jit_max_depth: Optional[int] = None):
+        self.rng = np.random.default_rng(seed)
+        self.nv = n_validators
+        self.max_nodes = max_nodes
+        self.chain_bias = chain_bias  # P(new block extends the last tip)
+        self.last_root: Optional[bytes] = None
+        self.host = ProtoArrayForkChoice(prune_threshold=prune_threshold)
+        self.dev = DeviceProtoArrayForkChoice(
+            prune_threshold=prune_threshold, engine=engine,
+            jit_max_depth=jit_max_depth)
+        self.anchor = _root(0)
+        self.next_id = 1
+        self.slot = 1
+        self.jcp = (1, _root(0))
+        self.fcp = (1, _root(0))
+        self.head_rounds = 0
+        for pa in (self.host, self.dev):
+            pa.on_block(slot=0, root=self.anchor, parent_root=ZERO_ROOT,
+                        state_root=self.anchor, justified_epoch=1,
+                        justified_root=_root(0), finalized_epoch=1,
+                        finalized_root=_root(0),
+                        execution_status=EXEC_OPTIMISTIC)
+
+    # -- ops -----------------------------------------------------------------
+
+    def _known_roots(self) -> List[bytes]:
+        return list(self.host.indices.keys())
+
+    def _pick_root(self) -> bytes:
+        roots = self._known_roots()
+        return roots[int(self.rng.integers(len(roots)))]
+
+    def op_block(self) -> None:
+        if self.max_nodes is not None \
+                and len(self.host.nodes) >= self.max_nodes:
+            return
+        root = _root(self.next_id)
+        self.next_id += 1
+        self.slot += 1
+        if self.last_root is not None \
+                and self.rng.random() < self.chain_bias \
+                and self.last_root in self.host.indices:
+            parent = self.last_root  # chain-shaped growth (non-finality)
+        elif self.rng.random() < 0.06:
+            parent = _root(10_000_000 + self.next_id)  # unknown: new root
+        else:
+            parent = self._pick_root()
+        self.last_root = root
+        je, jr = (2, _root(0)) if self.rng.random() < 0.15 else (1, _root(0))
+        for pa in (self.host, self.dev):
+            pa.on_block(slot=self.slot, root=root, parent_root=parent,
+                        state_root=root, justified_epoch=je,
+                        justified_root=jr, finalized_epoch=1,
+                        finalized_root=_root(0),
+                        execution_status=EXEC_OPTIMISTIC)
+
+    def op_attestation(self) -> None:
+        k = int(self.rng.integers(1, 9))
+        vals = self.rng.choice(self.nv, size=k, replace=False).astype(
+            np.int64)
+        epoch = int(self.rng.integers(0, 7))
+        if self.rng.random() < 0.05:
+            target = _root(20_000_000)  # unknown target: identical raise
+        else:
+            target = self._pick_root()
+        batch = [(vals, target, epoch)]
+        _call_both(lambda: self.host.process_attestation_batch(batch),
+                   lambda: self.dev.process_attestation_batch(batch),
+                   "attestation")
+
+    def op_equivocation(self) -> None:
+        v = int(self.rng.integers(self.nv))
+        for pa in (self.host, self.dev):
+            pa.process_equivocation(v)
+
+    def op_invalidate(self) -> None:
+        root = self._pick_root()
+        if root == self.anchor:
+            return  # keep the walk productive: a dead anchor ends heads
+        for pa in (self.host, self.dev):
+            pa.on_invalid_execution_payload(root)
+
+    def op_validate(self) -> None:
+        root = self._pick_root()
+        _call_both(lambda: self.host.on_valid_execution_payload(root),
+                   lambda: self.dev.on_valid_execution_payload(root),
+                   "on_valid")
+
+    def op_prune(self) -> None:
+        root = self._pick_root()
+        for pa in (self.host, self.dev):
+            pa.maybe_prune(root)
+        if root in self.host.indices \
+                and self.host.indices[root] == 0:
+            self.anchor = root
+
+    def op_head(self) -> None:
+        bal = self.rng.integers(0, 100, self.nv).astype(np.uint64)
+        boost_root, boost_score = ZERO_ROOT, 0
+        if self.rng.random() < 0.3:
+            boost_root = self._pick_root()
+            boost_score = int(self.rng.integers(0, 50))
+        if self.rng.random() < 0.1:
+            self.jcp = (2, _root(0)) if self.jcp[0] == 1 else (1, _root(0))
+
+        def run(pa):
+            deltas = pa.compute_deltas(bal.copy())
+            pa.apply_score_changes(deltas, self.jcp, self.fcp,
+                                   boost_root, boost_score, self.slot)
+            return pa.find_head(self.anchor, self.slot)
+
+        hh, dh, err = _call_both(lambda: run(self.host),
+                                 lambda: run(self.dev), "head")
+        if err is None and hh != dh:
+            raise MismatchError(
+                f"head mismatch: {hh.hex()[:8]} vs {dh.hex()[:8]}")
+        self.head_rounds += 1
+        self.compare_state()
+
+    # -- differential --------------------------------------------------------
+
+    def compare_state(self) -> None:
+        host, dev = self.host, self.dev
+        if host.indices != dev.indices:
+            raise MismatchError("indices diverged")
+        n = len(host.nodes)
+        cols = dev.cols
+        if cols.n != n:
+            raise MismatchError("node count diverged")
+        for i, node in enumerate(host.nodes):
+            got = (int(cols.weight[i]),
+                   None if cols.best_child[i] < 0
+                   else int(cols.best_child[i]),
+                   None if cols.best_desc[i] < 0
+                   else int(cols.best_desc[i]),
+                   int(cols.exec_status[i]))
+            want = (node.weight, node.best_child, node.best_descendant,
+                    node.execution_status)
+            if got != want:
+                raise MismatchError(
+                    f"node {i}: columnar {got} != host {want}")
+        dv = dev.votes
+        hv = host.votes
+        for name in ("current", "next", "next_epoch"):
+            a, b = getattr(hv, name), getattr(dv, name)
+            if a.shape != b.shape or not np.array_equal(a, b):
+                raise MismatchError(f"votes.{name} diverged")
+        if not np.array_equal(host.old_balances, dev.old_balances):
+            raise MismatchError("old_balances diverged")
+        if host.equivocating != dev.equivocating:
+            raise MismatchError("equivocating set diverged")
+
+    # -- schedule ------------------------------------------------------------
+
+    def run(self, *, blocks: int = 30, atts: int = 40,
+            equivocations: int = 3, invalidations: int = 3,
+            validations: int = 3, prunes: int = 2,
+            head_rounds: int = 10) -> int:
+        """Execute one shuffled interleaving; returns the number of head
+        rounds compared."""
+        ops = (["block"] * blocks + ["att"] * atts
+               + ["equiv"] * equivocations + ["invalid"] * invalidations
+               + ["valid"] * validations + ["prune"] * prunes
+               + ["head"] * head_rounds)
+        self.rng.shuffle(ops)
+        fns = {"block": self.op_block, "att": self.op_attestation,
+               "equiv": self.op_equivocation,
+               "invalid": self.op_invalidate, "valid": self.op_validate,
+               "prune": self.op_prune, "head": self.op_head}
+        for op in ops:
+            fns[op]()
+        # Always end on a compared head round.
+        self.op_head()
+        return self.head_rounds
+
+
+def run_fuzz(*, seeds, engine: str = "numpy", n_validators: int = 64,
+             max_nodes: Optional[int] = None, chain_bias: float = 0.0,
+             jit_max_depth: Optional[int] = None, **schedule) -> int:
+    """Run one DifferentialRun per seed; returns total compared head
+    rounds (raises MismatchError on the first divergence)."""
+    total = 0
+    for seed in seeds:
+        run = DifferentialRun(int(seed), n_validators=n_validators,
+                              engine=engine, max_nodes=max_nodes,
+                              chain_bias=chain_bias,
+                              jit_max_depth=jit_max_depth)
+        total += run.run(**schedule)
+    return total

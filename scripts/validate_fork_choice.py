@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Randomized fork-choice differential fuzzer — host oracle vs columnar.
+
+Drives seeded random DAG/vote/prune/invalidation interleavings through the
+host ProtoArrayForkChoice and the columnar DeviceProtoArrayForkChoice
+(numpy engine by default, the jitted device engine with ``--device``) and
+exits 1 on ANY divergence: head roots, per-node weights/links, vote
+columns, balances, equivocations, or error behaviour.
+
+    python scripts/validate_fork_choice.py --blocks 40 --atts 60 \
+        --equivocations 4 --seeds 20
+    python scripts/validate_fork_choice.py --device --warmup
+
+Compile-cache note (CPU): the fused device kernel is merkle-scale
+(seconds per shape); ``--warmup`` pre-lowers the shape buckets the run
+will touch so timing noise stays out of the differential.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=30,
+                    help="block inserts per interleaving")
+    ap.add_argument("--atts", type=int, default=40,
+                    help="attestation batches per interleaving")
+    ap.add_argument("--equivocations", type=int, default=3)
+    ap.add_argument("--invalidations", type=int, default=3)
+    ap.add_argument("--prunes", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=10,
+                    help="compared head rounds per interleaving")
+    ap.add_argument("--seeds", type=int, default=20,
+                    help="number of seeded interleavings")
+    ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--validators", type=int, default=64)
+    ap.add_argument("--device", action="store_true",
+                    help="columnar side runs the jitted device engine")
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile the fused kernel shape buckets")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.testing.fork_choice_fuzz import (MismatchError,
+                                                         run_fuzz)
+
+    engine = "jit" if args.device else "numpy"
+    max_nodes = None
+    if args.device:
+        # Bound the node count so the jitted shapes stay within the
+        # warmed buckets (pow-2 growth would recompile per bucket).
+        max_nodes = args.blocks + 8
+    if args.warmup and args.device:
+        from lighthouse_tpu.fork_choice.device_proto_array import warmup
+        t0 = time.perf_counter()
+        warmup(max_nodes, args.validators)
+        print(json.dumps({"warmup_s": round(time.perf_counter() - t0, 1)}))
+
+    t0 = time.perf_counter()
+    try:
+        rounds = run_fuzz(
+            seeds=range(args.seed0, args.seed0 + args.seeds),
+            engine=engine, n_validators=args.validators,
+            max_nodes=max_nodes, blocks=args.blocks, atts=args.atts,
+            equivocations=args.equivocations,
+            invalidations=args.invalidations, prunes=args.prunes,
+            head_rounds=args.heads)
+    except MismatchError as e:
+        print(json.dumps({"result": "MISMATCH", "error": str(e)}))
+        return 1
+    print(json.dumps({
+        "result": "ok", "engine": engine, "seeds": args.seeds,
+        "head_rounds_compared": rounds,
+        "elapsed_s": round(time.perf_counter() - t0, 1)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,390 @@
+"""Device (columnar) fork choice: randomized differentials against the
+host proto-array oracle, vote-buffer merge semantics, EL-invalidation
+revert, persistence of the columnar form, and the slasher equivocation
+wiring.
+
+Everything here is quick-tier: the jitted fused kernel is merkle-scale
+(seconds to compile on CPU) and the differential shapes stay inside two
+pow-2 buckets.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.fork_choice import (
+    DeviceProtoArrayForkChoice,
+    EXEC_OPTIMISTIC,
+    ForkChoice,
+    ProtoArrayForkChoice,
+)
+from lighthouse_tpu.fork_choice.proto_array import ZERO_ROOT
+from lighthouse_tpu.store import HotColdDB
+from lighthouse_tpu.testing.fork_choice_fuzz import run_fuzz
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+def root(i: int) -> bytes:
+    return bytes([i]) + b"\x00" * 31
+
+
+class _Indexed:
+    def __init__(self, data, indices):
+        self.data = data
+        self.attesting_indices = indices
+
+
+def make_pair(chain=((1, 0),), engine="numpy", prune_threshold=256):
+    """Identical host + columnar trees from (node, parent) ids."""
+    out = []
+    for cls, kw in ((ProtoArrayForkChoice, {}),
+                    (DeviceProtoArrayForkChoice, {"engine": engine})):
+        pa = cls(prune_threshold=prune_threshold, **kw)
+        pa.on_block(slot=0, root=root(0), parent_root=ZERO_ROOT,
+                    state_root=root(0), justified_epoch=1,
+                    justified_root=root(0), finalized_epoch=1,
+                    finalized_root=root(0),
+                    execution_status=EXEC_OPTIMISTIC)
+        for node, parent in chain:
+            pa.on_block(slot=node, root=root(node), parent_root=root(parent),
+                        state_root=root(node), justified_epoch=1,
+                        justified_root=root(0), finalized_epoch=1,
+                        finalized_root=root(0),
+                        execution_status=EXEC_OPTIMISTIC)
+        out.append(pa)
+    return out
+
+
+def heads_of(pair, balances, anchor=None, boost=ZERO_ROOT, score=0):
+    anchor = anchor or root(0)
+    got = []
+    for pa in pair:
+        deltas = pa.compute_deltas(np.asarray(balances, np.uint64))
+        pa.apply_score_changes(deltas, (1, root(0)), (1, root(0)),
+                               boost, score, 10)
+        got.append(pa.find_head(anchor, 10))
+    assert got[0] == got[1], (got[0].hex()[:8], got[1].hex()[:8])
+    return got[0]
+
+
+def assert_state_equal(host, dev):
+    assert host.indices == dev.indices
+    for i, node in enumerate(host.nodes):
+        dn = dev.nodes[i]
+        assert (node.weight, node.best_child, node.best_descendant,
+                node.execution_status) == \
+               (dn.weight, dn.best_child, dn.best_descendant,
+                dn.execution_status), i
+    for name in ("current", "next", "next_epoch"):
+        assert np.array_equal(getattr(host.votes, name),
+                              getattr(dev.votes, name)), name
+
+
+# -- randomized differentials (the acceptance gate) -------------------------
+
+
+def test_randomized_differential_numpy_engine():
+    """≥200 random DAG/vote/prune/invalidation interleavings, full-state
+    compared after every head round."""
+    rounds = run_fuzz(seeds=range(20), engine="numpy")
+    assert rounds >= 200, rounds
+
+
+def test_randomized_differential_jit_engine():
+    """The fused jitted kernel is bit-identical to the host over random
+    interleavings (node count capped inside one shape bucket)."""
+    rounds = run_fuzz(seeds=range(3), engine="jit", max_nodes=48)
+    assert rounds >= 30, rounds
+
+
+def test_randomized_differential_chain_shaped_trees():
+    """Chain-shaped growth (long non-finality) drives the walk arm of the
+    adaptive apply dispatch past _WALK_DEPTH — still bit-identical."""
+    from lighthouse_tpu.fork_choice import columnar as C
+    old = C._WALK_DEPTH
+    C._WALK_DEPTH = 8  # force the walk arm inside fuzz-sized trees
+    try:
+        rounds = run_fuzz(seeds=range(8), engine="numpy", chain_bias=0.9)
+        assert rounds >= 80, rounds
+    finally:
+        C._WALK_DEPTH = old
+
+
+def test_jit_engine_deep_chain_falls_back_and_agrees():
+    """Past jit_max_depth the jit engine runs head rounds on host while
+    keeping the device mirrors in lock-step; shallow rounds after a
+    prune resume on the kernel — all bit-identical."""
+    rounds = run_fuzz(seeds=range(2), engine="jit", chain_bias=0.9,
+                      max_nodes=48, jit_max_depth=12)
+    assert rounds >= 20, rounds
+
+
+def test_jit_engine_survives_validator_bucket_growth():
+    """Regression: a buffered vote beyond the validator pow-2 bucket used
+    to drop the mirror between the fit check and the kernel call
+    (AssertionError in _apply_jit).  Now the mirror re-buckets."""
+    host, dev = make_pair([(1, 0), (2, 0)], engine="jit")
+    for pa in (host, dev):
+        pa.process_attestation(0, root(1), 1)
+    heads_of((host, dev), [10] * 8)  # nv_pad settles at the small bucket
+    for pa in (host, dev):
+        pa.process_attestation(40, root(2), 1)  # crosses the bucket
+    bal = [10] * 41
+    assert heads_of((host, dev), bal) == root(2)
+    assert_state_equal(host, dev)
+
+
+def test_fuzzer_catches_injected_divergence():
+    """The differential has teeth: corrupt one columnar weight and the
+    next head round must flag it."""
+    from lighthouse_tpu.testing.fork_choice_fuzz import (DifferentialRun,
+                                                         MismatchError)
+    run = DifferentialRun(1, engine="numpy")
+    run.op_block()
+    run.op_attestation()
+    run.op_head()
+    run.dev.cols.weight[0] += 7
+    with pytest.raises(MismatchError):
+        run.compare_state()
+
+
+# -- vote buffer semantics ---------------------------------------------------
+
+
+def test_batched_votes_match_sequential_fold():
+    """Stale epochs, equal-epoch ordering, and re-votes inside ONE buffer
+    window must merge exactly like the host's sequential updates."""
+    host, dev = make_pair([(1, 0), (2, 0)])
+    seq = [(0, root(1), 3), (0, root(2), 3),  # equal epoch: first wins
+           (1, root(2), 2), (1, root(1), 1),  # stale epoch ignored
+           (2, root(1), 1), (2, root(2), 5), (2, root(1), 4)]
+    for v, r, e in seq:
+        host.process_attestation(v, r, e)
+        dev.process_attestation(v, r, e)
+    assert heads_of((host, dev), [10, 10, 10]) is not None
+    assert_state_equal(host, dev)
+
+
+def test_equivocation_interleaves_with_buffered_votes():
+    """A vote buffered BEFORE process_equivocation still lands; one
+    buffered AFTER is blocked — matching host call order."""
+    host, dev = make_pair([(1, 0), (2, 0)])
+    for pa in (host, dev):
+        pa.process_attestation(0, root(1), 1)
+        pa.process_equivocation(0)
+        pa.process_attestation(0, root(2), 5)  # blocked on both
+        pa.process_attestation(1, root(2), 1)
+    heads_of((host, dev), [50, 10])
+    assert_state_equal(host, dev)
+    assert host.equivocating == dev.equivocating == {0}
+
+
+def test_post_prune_stale_epoch_readmits_vote():
+    """After pruning, a dangling vote's next_epoch stays stale while next
+    is −1 — the host re-admits ANY epoch then; the batch must too."""
+    host, dev = make_pair([(1, 0), (2, 1), (3, 2), (4, 3)],
+                          prune_threshold=1)
+    for pa in (host, dev):
+        pa.process_attestation(0, root(1), 9)  # will dangle after prune
+    heads_of((host, dev), [10])
+    for pa in (host, dev):
+        pa.maybe_prune(root(2))
+        pa.process_attestation(0, root(4), 2)  # 2 < 9 but next == -1
+    heads_of((host, dev), [10], anchor=root(2))
+    assert_state_equal(host, dev)
+    assert int(dev.votes.next_epoch[0]) == 2
+
+
+def test_whole_slot_batch_replaces_per_attestation_walk():
+    """process_attestation_batch on the columnar path buffers whole
+    attestations vectorized and agrees with the host loop."""
+    host, dev = make_pair([(1, 0), (2, 0)])
+    batch = [(np.arange(16), root(1), 1),
+             (np.arange(8, 24), root(2), 2)]
+    host.process_attestation_batch(batch)
+    dev.process_attestation_batch(batch)
+    heads_of((host, dev), [10] * 24)
+    assert_state_equal(host, dev)
+
+
+# -- invalidation revert -----------------------------------------------------
+
+
+def test_invalidation_reverts_head_and_removes_subtree_weight():
+    host, dev = make_pair([(1, 0), (2, 0), (3, 1), (4, 3)])
+    for pa in (host, dev):
+        pa.process_attestation(0, root(4), 1)
+    assert heads_of((host, dev), [50]) == root(4)
+    for pa in (host, dev):
+        pa.on_invalid_execution_payload(root(1))
+    assert heads_of((host, dev), [50]) == root(2)
+    assert_state_equal(host, dev)
+    # the removal propagated: no phantom subtree weight on the anchor
+    assert dev.nodes[dev.indices[root(3)]].weight == 0
+    assert dev.nodes[dev.indices[root(4)]].weight == 0
+
+
+# -- ForkChoice wrapper: both knob paths agree over a real chain -------------
+
+
+def test_forkchoice_device_and_host_paths_agree_on_harness_chain():
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        genesis_root = hdr.tree_hash_root()
+        fcs = [ForkChoice(h.preset, h.spec, genesis_root=genesis_root,
+                          genesis_state=h.state.copy(), device=dev)
+               for dev in (False, True)]
+        from lighthouse_tpu.state_transition.committees import (
+            get_beacon_committee)
+        for _ in range(5):
+            signed = h.build_block()
+            h.apply_block(signed)
+            block_root = signed.message.tree_hash_root()
+            heads = []
+            for fc in fcs:
+                fc.on_tick(int(signed.message.slot))
+                fc.on_block(signed, block_root, h.state.copy(),
+                            is_timely=True)
+                for att in signed.message.body.attestations:
+                    committee = get_beacon_committee(
+                        h.state, int(att.data.slot), int(att.data.index),
+                        h.preset)
+                    bits = np.asarray(att.aggregation_bits, dtype=bool)
+                    idx = np.asarray(committee)[bits[:len(committee)]]
+                    fc.on_attestation(_Indexed(att.data, idx.tolist()))
+                heads.append(fc.get_head())
+            assert heads[0] == heads[1] == block_root
+        # capella blocks carry payloads: imported OPTIMISTIC, revertable
+        proto = fcs[1].proto
+        tip = fcs[1].get_head()
+        assert proto.cols.exec_status[proto.indices[tip]] \
+            == EXEC_OPTIMISTIC
+    finally:
+        B.set_backend("python")
+
+
+def test_persistence_roundtrip_restores_columnar_form(tmp_path):
+    """encode → decode lands back in the columnar form with identical
+    head, votes, and weights (knob on = default)."""
+    from lighthouse_tpu.fork_choice.persistence import (decode_fork_choice,
+                                                        encode_fork_choice)
+    B.set_backend("fake")
+    try:
+        h = StateHarness(n_validators=16, preset=MINIMAL)
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        genesis_root = hdr.tree_hash_root()
+        fc = ForkChoice(h.preset, h.spec, genesis_root=genesis_root,
+                        genesis_state=h.state.copy(), device=True)
+        for _ in range(3):
+            signed = h.build_block()
+            h.apply_block(signed)
+            fc.on_tick(int(signed.message.slot))
+            fc.on_block(signed, signed.message.tree_hash_root(),
+                        h.state.copy())
+        head = fc.get_head()
+        blob = encode_fork_choice(fc)
+        fc2 = decode_fork_choice(blob, preset=h.preset, spec=h.spec,
+                                 justified_state=h.state.copy())
+        assert isinstance(fc2.proto, DeviceProtoArrayForkChoice)
+        assert fc2.get_head() == head
+        assert np.array_equal(fc2.proto.votes.next, fc.proto.votes.next)
+        assert [n.weight for n in fc2.proto.nodes] \
+            == [n.weight for n in fc.proto.nodes]
+    finally:
+        B.set_backend("python")
+
+
+# -- chain integration: EL invalidation + slasher wiring ---------------------
+
+
+def _make_chain(n_validators=16):
+    h = StateHarness(n_validators=n_validators, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    genesis_root = hdr.tree_hash_root()
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    db = HotColdDB.memory(h.preset, h.spec, h.T)
+    chain = BeaconChain(store=db, genesis_state=h.state.copy(),
+                        genesis_block_root=genesis_root,
+                        preset=h.preset, spec=h.spec, T=h.T)
+    return h, chain
+
+
+def test_chain_el_invalidation_reverts_head_and_repacks_pool():
+    B.set_backend("fake")
+    try:
+        h, chain = _make_chain()
+        roots = []
+        for _ in range(3):
+            signed = h.build_block()
+            h.apply_block(signed)
+            chain.per_slot_task(int(signed.message.slot))
+            roots.append(chain.process_block(signed, is_timely=True))
+        assert chain.head.root == roots[-1]
+        q = chain.event_bus.subscribe(["payload_invalidated"])
+        chain.on_invalid_execution_payload(roots[1])
+        # head reverted OFF the invalidated branch to its parent
+        assert chain.head.root == roots[0]
+        assert not q.empty()
+        # descendants are dead in fork choice
+        proto = chain.fork_choice.proto
+        from lighthouse_tpu.fork_choice import EXEC_INVALID
+        for r in roots[1:]:
+            assert proto.cols.exec_status[proto.indices[r]] == EXEC_INVALID
+        # the chain keeps running off the reverted head
+        assert chain.recompute_head() == roots[0]
+    finally:
+        B.set_backend("python")
+
+
+def test_slasher_double_vote_feeds_fork_choice_equivocation():
+    """attach_slasher: a double vote observed via the verified-attestation
+    path lands in the vote buffer as an equivocation at the next slot
+    tick, and the batched delta pass zeroes the validator's weight."""
+    from lighthouse_tpu.slasher import Slasher
+    B.set_backend("fake")
+    try:
+        h, chain = _make_chain()
+        chain.attach_slasher(Slasher(16))
+        signed = h.build_block()
+        h.apply_block(signed)
+        chain.per_slot_task(int(signed.message.slot))
+        chain.process_block(signed, is_timely=True)
+
+        class _V:
+            pass
+
+        atts = h.attestations_for_slot(h.state, int(h.state.slot) - 1)
+        from lighthouse_tpu.beacon_chain.attestation_verification import (
+            attesting_indices)
+        idx, committee = attesting_indices(h.state, atts[0], h.preset)
+        verified = _V()
+        verified.attestation = atts[0]
+        verified.indexed_indices = idx.tolist()
+        verified.committee = committee
+        chain.register_verified_attestation(verified)
+        # conflicting copy: same target epoch, different data
+        import copy
+        att2 = type(atts[0]).deserialize(type(atts[0]).serialize(atts[0]))
+        att2.data.beacon_block_root = b"\x77" * 32
+        verified2 = _V()
+        verified2.attestation = att2
+        verified2.indexed_indices = idx.tolist()
+        verified2.committee = committee
+        chain.register_verified_attestation(verified2)
+        assert not chain.fork_choice.proto.equivocating
+        chain.per_slot_task(int(h.state.slot) + 1)
+        assert set(int(i) for i in idx) \
+            <= chain.fork_choice.proto.equivocating
+        # equivocators carry no weight in the next batched pass
+        chain.recompute_head()
+        bal = chain.fork_choice.proto.old_balances
+        for v in idx:
+            assert int(bal[int(v)]) == 0
+    finally:
+        B.set_backend("python")

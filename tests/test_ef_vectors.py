@@ -48,7 +48,10 @@ def test_ef_vectors_python_backend(vectors_root):
     # meaningful coverage: every wired runner produced passes
     runners = {r for (r, _h) in report.passed}
     assert {"sanity", "operations", "epoch_processing", "ssz_static",
-            "shuffling", "bls", "transition", "rewards"} <= runners
+            "shuffling", "bls", "transition", "rewards",
+            "fork_choice"} <= runners
+    # the fork_choice slice must include a mainnet-preset case
+    assert report.passed.get(("fork_choice", "get_head"), 0) >= 6
     # the adversarial zoo: a meaningful share of expected-invalid cases
     invalid = 0
     total = 0
