@@ -454,6 +454,38 @@ class HttpApiServer:
             self._serve_events(h)
         elif path == "/metrics":
             h._text(REGISTRY.encode())
+        elif path == "/lighthouse/tracing/slots":
+            # Assembled slot-trace ring: one summary row per slot still
+            # held (slot, span count, wall ms, pipeline stages present).
+            from ..common.tracing import TRACER
+            h._json({"data": {"enabled": TRACER.enabled,
+                              "ring": TRACER.max_slots,
+                              "evicted": TRACER.evicted_slots,
+                              "dropped_stale": TRACER.dropped_stale,
+                              "slots": TRACER.slot_summaries()}})
+        elif path.startswith("/lighthouse/tracing/slot/"):
+            from ..common.tracing import TRACER
+            try:
+                slot = int(path.split("/")[-1])
+            except ValueError:
+                h._json({"code": 400, "message": "bad slot"}, 400)
+                return
+            qs = parse_qs(urlparse(h.path).query)
+            fmt = qs.get("format", ["json"])[0]
+            if fmt == "chrome_trace":
+                trace = TRACER.chrome_trace(slot)
+            elif fmt == "json":
+                trace = TRACER.slot_trace(slot)
+            else:
+                h._json({"code": 400,
+                         "message": f"unknown format {fmt}"}, 400)
+                return
+            if trace is None:
+                h._json({"code": 404,
+                         "message": f"no trace for slot {slot} "
+                                    "(evicted or never traced)"}, 404)
+            else:
+                h._json(trace)
         elif path == "/lighthouse/validator_monitor":
             mon = chain.validator_monitor
             h._json({"data": [] if mon is None else mon.summaries()})

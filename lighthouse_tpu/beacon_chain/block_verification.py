@@ -20,6 +20,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from ..common.tracing import TRACER
 from ..crypto import bls
 from ..state_transition import SignatureStrategy, state_transition
 from ..state_transition.committees import get_beacon_proposer_index
@@ -47,6 +48,12 @@ class GossipVerifiedBlock:
 
     @classmethod
     def new(cls, chain, signed_block) -> "GossipVerifiedBlock":
+        with TRACER.span("gossip_verify", cat="block_import",
+                         slot=int(signed_block.message.slot)):
+            return cls._new(chain, signed_block)
+
+    @classmethod
+    def _new(cls, chain, signed_block) -> "GossipVerifiedBlock":
         block = signed_block.message
         slot = int(block.slot)
         if slot > chain.current_slot():
@@ -130,11 +137,19 @@ class ExecutedBlock:
         try:
             fork = chain.spec.fork_name_at_epoch(
                 int(state.slot) // chain.preset.SLOTS_PER_EPOCH)
-            process_block(state, sv.signed_block, fork, chain.preset,
-                          chain.spec, chain.T,
-                          strategy=SignatureStrategy.VERIFY_BULK,
-                          pubkey_cache=chain.pubkey_cache,
-                          payload_verifier=chain.payload_verifier)
+            # The transition span carries the per-phase children (the
+            # stage adapter converts per_block.LAST_BLOCK_TIMINGS inside
+            # process_block) and the device residency deltas — the
+            # device-stage attribution of this block's import.
+            with TRACER.span("state_transition", cat="state_transition",
+                             slot=int(block.slot)) as _sp:
+                _mark = TRACER.residency_mark()
+                process_block(state, sv.signed_block, fork, chain.preset,
+                              chain.spec, chain.T,
+                              strategy=SignatureStrategy.VERIFY_BULK,
+                              pubkey_cache=chain.pubkey_cache,
+                              payload_verifier=chain.payload_verifier)
+                TRACER.record_residency(_sp, _mark)
         except (BlockProcessingError, SszError, ValueError) as e:
             # Signature batch failures are InvalidSignatures; every other
             # transition rejection keeps its own label.  Programming errors
@@ -142,7 +157,8 @@ class ExecutedBlock:
             if "signature" in str(e).lower():
                 raise InvalidSignatures(str(e)) from e
             raise InvalidBlock(str(e)) from e
-        root = state.tree_hash_root()
+        with TRACER.span("post_state_root", cat="state_transition"):
+            root = state.tree_hash_root()
         if root != bytes(block.state_root):
             raise StateRootMismatch(
                 f"{root.hex()} != {bytes(block.state_root).hex()}")

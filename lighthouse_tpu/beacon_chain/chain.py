@@ -17,6 +17,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..common.tracing import TRACER
 from ..fork_choice import ForkChoice
 from ..op_pool import OperationPool
 from ..state_transition import signature_sets as sigs
@@ -260,6 +261,7 @@ class BeaconChain:
 
     def per_slot_task(self, slot: int) -> None:
         """`timer` service hook (`beacon_chain.rs:5322`)."""
+        TRACER.set_slot(slot)  # ambient slot scope for this tick's spans
         self.fork_choice.on_tick(slot)
         self._drain_slasher(slot)
         self.observed_attesters.prune(slot // self.preset.SLOTS_PER_EPOCH)
@@ -438,42 +440,49 @@ class BeaconChain:
         :class:`~.errors.BlobsUnavailable` and is NOT imported — the
         network layer retries after fetching the blobs.
         """
-        g = GossipVerifiedBlock.new(self, signed_block)
-        self.block_times_cache.observed(g.block_root)
-        if blob_sidecars:
-            self.data_availability.put_sidecars(list(blob_sidecars))
-        ex = self.data_availability.pop_executed_block(g.block_root)
-        if ex is None:
-            sv = SignatureVerifiedBlock.from_gossip_verified(self, g)
-            ex = ExecutedBlock.from_signature_verified(self, sv)
-        # Availability is asserted AFTER full verification (the reference
-        # gates between execution and fork-choice import): only blocks
-        # whose proposer signature and transition are already proven wait
-        # on blobs, so an attacker cannot park junk in the pending map
-        # under a real block's root and stall it.  A stalled block is
-        # parked; its retry (same root — NOT a repeat proposal) resumes
-        # from the executed stage.
-        try:
-            self.data_availability.check_availability(signed_block,
-                                                      g.block_root)
-        except BlockError:
-            self.data_availability.hold_executed_block(g.block_root, ex)
-            raise
-        self._import_block(ex, is_timely=is_timely)
-        return ex.block_root
+        with TRACER.span("block_import", cat="block_import",
+                         slot=int(signed_block.message.slot)) as _sp:
+            g = GossipVerifiedBlock.new(self, signed_block)
+            self.block_times_cache.observed(g.block_root)
+            if blob_sidecars:
+                self.data_availability.put_sidecars(list(blob_sidecars))
+            ex = self.data_availability.pop_executed_block(g.block_root)
+            if ex is None:
+                sv = SignatureVerifiedBlock.from_gossip_verified(self, g)
+                ex = ExecutedBlock.from_signature_verified(self, sv)
+            # Availability is asserted AFTER full verification (the
+            # reference gates between execution and fork-choice import):
+            # only blocks whose proposer signature and transition are
+            # already proven wait on blobs, so an attacker cannot park
+            # junk in the pending map under a real block's root and stall
+            # it.  A stalled block is parked; its retry (same root — NOT
+            # a repeat proposal) resumes from the executed stage.
+            try:
+                with TRACER.span("availability_check", cat="da_kzg"):
+                    self.data_availability.check_availability(
+                        signed_block, g.block_root)
+            except BlockError:
+                self.data_availability.hold_executed_block(g.block_root,
+                                                           ex)
+                raise
+            self._import_block(ex, is_timely=is_timely)
+            _sp.set(root=ex.block_root.hex())
+            return ex.block_root
 
     def _import_block(self, ex: ExecutedBlock, *, is_timely: bool) -> None:
         block_root = ex.block_root
         state = ex.post_state
         state_root = bytes(ex.signed_block.message.state_root)
-        self.store.put_block(block_root, ex.signed_block)
-        self.store.put_state(state_root, state.copy(), block_root)
-        # Persist the availability-gate sidecars alongside the block
-        # (served by blob_sidecars_by_range/by_root and the HTTP API).
-        for sc in self.data_availability.take_sidecars(block_root):
-            self.store.put_blob_sidecar(block_root, int(sc.index), sc)
-        self.fork_choice.on_block(ex.signed_block, block_root, state,
-                                  is_timely=is_timely)
+        with TRACER.span("store_put", cat="block_import"):
+            self.store.put_block(block_root, ex.signed_block)
+            self.store.put_state(state_root, state.copy(), block_root)
+            # Persist the availability-gate sidecars alongside the block
+            # (served by blob_sidecars_by_range/by_root + the HTTP API).
+            for sc in self.data_availability.take_sidecars(block_root):
+                self.store.put_blob_sidecar(block_root, int(sc.index), sc)
+        with TRACER.span("fork_choice_on_block", cat="fork_choice"):
+            self.fork_choice.on_block(ex.signed_block, block_root, state,
+                                      is_timely=is_timely)
         self._states_by_block[block_root] = state
         self.block_times_cache.imported(block_root)
         # Prime the attester caches from the post-state we already hold:
@@ -610,7 +619,12 @@ class BeaconChain:
 
     def recompute_head(self) -> bytes:
         """`recompute_head` (`canonical_head.rs`)."""
+        with TRACER.span("head_update", cat="head") as _sp:
+            return self._recompute_head(_sp)
+
+    def _recompute_head(self, _sp) -> bytes:
         head_root = self.fork_choice.get_head()
+        _sp.set(head=head_root.hex(), changed=head_root != self.head.root)
         if head_root != self.head.root:
             state = self.state_at_block_root(head_root)
             self.head = CanonicalHead(root=head_root,

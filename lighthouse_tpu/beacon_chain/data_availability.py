@@ -73,12 +73,27 @@ class DataAvailabilityChecker:
         """One batched KZG verification, through the resilient service
         when attached (raises ``kzg.KzgError`` on malformed data either
         way)."""
-        if self.verify_batch_fn is not None:
-            return self.verify_batch_fn(blobs, commitments, proofs,
-                                        self.setup)
-        from .. import kzg as KZ
-        return KZ.verify_blob_kzg_proof_batch(blobs, commitments, proofs,
-                                              self.setup)
+        from ..common.tracing import TRACER
+        with TRACER.span("kzg_batch_verify", cat="da_kzg",
+                         blobs=len(blobs)) as _sp:
+            if TRACER.enabled:
+                # A host-path verify leaves the device stage dict
+                # untouched; clear it so stale stages from a PREVIOUS
+                # device batch can't attach to this span.
+                from ..kzg.device import LAST_KZG_TIMINGS
+                LAST_KZG_TIMINGS.clear()
+            if self.verify_batch_fn is not None:
+                ok = self.verify_batch_fn(blobs, commitments, proofs,
+                                          self.setup)
+            else:
+                from .. import kzg as KZ
+                ok = KZ.verify_blob_kzg_proof_batch(
+                    blobs, commitments, proofs, self.setup)
+            # Device-stage attribution: the per-stage split the device
+            # path left in LAST_KZG_TIMINGS becomes child spans.
+            TRACER.record_stages("kzg", cat="da_kzg")
+            _sp.set(verdict=bool(ok))
+            return ok
 
     @property
     def setup(self):

@@ -16,6 +16,7 @@ from typing import Dict, Iterable, Optional
 import numpy as np
 
 from ..common.logging import Logger, test_logger
+from ..common.metrics import REGISTRY
 
 
 @dataclass
@@ -43,15 +44,89 @@ class MonitoredValidator:
 class ValidatorMonitor:
     """`ValidatorMonitor` — hooks called from the block-import path."""
 
+    # Per-validator gauge series are emitted only while the monitored
+    # set is at most this large (`--validator-monitor-individual-
+    # tracking-threshold` in the reference, same default): under
+    # --validator-monitor-auto the set approaches the whole registry,
+    # and 4 labeled series per validator would put millions of series
+    # in /metrics (a Prometheus cardinality explosion and a
+    # multi-hundred-MB scrape).  Summaries (`/lighthouse/
+    # validator_monitor`) keep full per-validator detail regardless.
+    INDIVIDUAL_TRACKING_THRESHOLD = 64
+
     def __init__(self, log: Optional[Logger] = None,
-                 auto_register: bool = False):
+                 auto_register: bool = False,
+                 individual_tracking_threshold: Optional[int] = None):
         self.log = (log or test_logger()).child("validator_monitor")
         self.auto_register = auto_register  # `--validator-monitor-auto` role
+        self.individual_tracking_threshold = (
+            self.INDIVIDUAL_TRACKING_THRESHOLD
+            if individual_tracking_threshold is None
+            else int(individual_tracking_threshold))
         self.validators: Dict[int, MonitoredValidator] = {}
+        self._individual_tracking = True
+        # Per-monitored-validator labeled gauges in the GLOBAL registry:
+        # `/metrics` and `/lighthouse/validator_monitor` report from the
+        # same MonitoredValidator records (one source — the gauges are
+        # synced whenever a record changes, never computed separately).
+        self._m_blocks = REGISTRY.gauge(
+            "validator_monitor_blocks_proposed",
+            "blocks proposed by a monitored validator",
+            labelnames=("validator",))
+        self._m_included = REGISTRY.gauge(
+            "validator_monitor_attestations_included",
+            "attestation inclusions of a monitored validator",
+            labelnames=("validator",))
+        self._m_distance = REGISTRY.gauge(
+            "validator_monitor_avg_inclusion_distance",
+            "average attestation inclusion distance (slots)",
+            labelnames=("validator",))
+        self._m_balance = REGISTRY.gauge(
+            "validator_monitor_balance_gwei",
+            "last observed balance of a monitored validator",
+            labelnames=("validator",))
+        # The families are process-global; a fresh monitor (chain
+        # re-init) starts its series clean — a PREVIOUS monitor's
+        # children would otherwise export frozen values for validators
+        # this instance never registered.  One live monitor per process
+        # is the (now explicit) assumption.
+        for fam in (self._m_blocks, self._m_included, self._m_distance,
+                    self._m_balance):
+            fam.clear_children()
+
+    def _sync_metrics(self, v: MonitoredValidator) -> None:
+        if len(self.validators) > self.individual_tracking_threshold:
+            # Beyond the threshold: stop per-validator series AND drop
+            # the ones created while the set was small — frozen children
+            # would otherwise export their last values forever with no
+            # signal that updates stopped.
+            if self._individual_tracking:
+                self._individual_tracking = False
+                for fam in (self._m_blocks, self._m_included,
+                            self._m_distance, self._m_balance):
+                    fam.clear_children()
+            return
+        self._individual_tracking = True
+        label = str(v.index)
+        s = v.summary()
+        self._m_blocks.labels(label).set(float(s["blocks_proposed"]))
+        self._m_included.labels(label).set(
+            float(s["attestations_included"]))
+        self._m_distance.labels(label).set(
+            float(s["avg_inclusion_distance"]))
+        if v.last_balance is not None:
+            self._m_balance.labels(label).set(float(v.last_balance))
 
     def register(self, indices: Iterable[int]) -> None:
-        for i in indices:
-            self.validators.setdefault(int(i), MonitoredValidator(int(i)))
+        added = [self.validators.setdefault(int(i),
+                                            MonitoredValidator(int(i)))
+                 for i in indices]
+        # Sync AFTER all adds: a bulk registration past the individual-
+        # tracking threshold creates zero per-validator series instead
+        # of series for the first `threshold` validators it happened to
+        # add before crossing it.
+        for v in added:
+            self._sync_metrics(v)
 
     def _get(self, index: int) -> Optional[MonitoredValidator]:
         v = self.validators.get(index)
@@ -67,8 +142,10 @@ class ValidatorMonitor:
         proposer = int(block.proposer_index)
         v = self._get(proposer)
         block_slot = int(block.slot)
+        touched: set[int] = set()
         if v is not None:
             v.blocks_proposed += 1
+            touched.add(proposer)
             self.log.info("block from monitored validator",
                           validator=proposer, slot=block_slot)
         for att_slot, indices in indexed_attestations:
@@ -80,6 +157,7 @@ class ValidatorMonitor:
                 v.attestations_included += 1
                 v.total_inclusion_distance += distance
                 v.last_attestation_slot = int(att_slot)
+                touched.add(int(i))
                 if distance > 1:
                     self.log.warn("late attestation inclusion",
                                   validator=int(i), slot=int(att_slot),
@@ -96,6 +174,18 @@ class ValidatorMonitor:
         for mv, bal in zip(
                 (mv for mv, ok in zip(mvs, in_range) if ok), vals):
             mv.last_balance = int(bal)
+        # Gauge sync ONLY for validators this block touched (proposer +
+        # included attesters): under --validator-monitor-auto the
+        # monitored set approaches the whole registry, and a whole-set
+        # scalar loop here would put O(registry) python work (and 4
+        # labeled series per validator) on the block-import path — the
+        # exact pathology the vectorized balance gather above avoids.
+        # Untouched validators' gauges refresh on their own next event
+        # (register / proposal / inclusion).
+        for idx in touched:
+            mv = self.validators.get(idx)
+            if mv is not None:
+                self._sync_metrics(mv)
 
     # -- export --------------------------------------------------------------
 

@@ -80,6 +80,7 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..common.backoff import backoff_delay
 from ..common.metrics import REGISTRY, observe
+from ..common.tracing import TRACER
 from ..ops.merkle import _next_pow2
 
 # -- message classes ---------------------------------------------------------
@@ -233,6 +234,10 @@ class CircuitBreaker:
             if ok:
                 if self.state != "closed":
                     self.recoveries += 1
+                    if TRACER.enabled:
+                        TRACER.instant("breaker_closed",
+                                       cat="verification_service",
+                                       breaker=self.registered_name)
                 self.state = "closed"
                 self.consecutive = 0
                 self.cooldown_s = self.base_cooldown_s
@@ -248,6 +253,10 @@ class CircuitBreaker:
                                       self.cooldown_max_s)
                 self.reopens += 1
                 self._m_state.set(1.0)
+                if TRACER.enabled:
+                    TRACER.instant("breaker_reopen",
+                                   cat="verification_service",
+                                   breaker=self.registered_name)
             elif self.state == "closed" \
                     and self.consecutive >= self.threshold:
                 self.state = "open"
@@ -257,6 +266,10 @@ class CircuitBreaker:
                 with _TRIPS_LOCK:
                     _TRIPS_TOTAL += 1
                 self._m_state.set(1.0)
+                if TRACER.enabled:
+                    TRACER.instant("breaker_open",
+                                   cat="verification_service",
+                                   breaker=self.registered_name)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -435,6 +448,15 @@ class ResilienceEnvelope:
         ``probe`` / ``host``.  With no ``host_fn`` a terminal device
         failure re-raises (callers that have no degraded mode keep their
         error semantics)."""
+        with TRACER.span(f"{self.name}_envelope",
+                         cat="verification_service") as sp:
+            out, path = self._call_inner(device_fn, host_fn, args,
+                                         deadline_s, retries)
+            sp.set(path=path)
+            return out, path
+
+    def _call_inner(self, device_fn, host_fn, args, deadline_s,
+                    retries) -> Tuple[object, str]:
         if deadline_s is False:
             deadline_s = self.deadline_s
         if retries is None:
@@ -507,6 +529,14 @@ class _Submission:
     on_result: Optional[Callable[[bool, str], None]] = None
     meta: object = None
     completed: bool = False         # _complete fired (idempotence guard)
+    trace_ctx: object = None        # SpanContext captured at submit —
+    #   the dispatch span (possibly on a pump thread) parents here, so
+    #   the verdict lands in the submitting slot's trace
+
+
+# Verdict-latency histogram labeled by message kind — the labeled-family
+# exposition (`stream_verify_latency_seconds{kind="attestation"}`).
+_LATENCY_LABELS = ("kind",)
 
 
 # Sync-contribution key lists at least this wide get a content
@@ -597,7 +627,8 @@ class VerificationService:
         self.pipeline_stats = {"items": 0, "fallbacks": 0}
         self._m_latency = REGISTRY.histogram(
             "stream_verify_latency_seconds",
-            "submit→verdict latency per message")
+            "submit→verdict latency per message",
+            labelnames=_LATENCY_LABELS)
         self._m_shed = REGISTRY.counter(
             "stream_verify_shed_total", "messages shed under overload")
 
@@ -637,7 +668,9 @@ class VerificationService:
         now = self._clock()
         sub = _Submission(kind=kind, sets=list(sets), enqueued=now,
                           deadline=now + self.slo_s, on_result=on_result,
-                          meta=meta)
+                          meta=meta,
+                          trace_ctx=TRACER.ctx() if TRACER.enabled
+                          else None)
         shed: List[_Submission] = []
         with self._lock:
             self.counters["submitted"] += 1
@@ -848,8 +881,18 @@ class VerificationService:
 
     def _dispatch_bucket(self, staged) -> int:
         subs, sets = staged
+        with TRACER.span("verify_dispatch", cat="verification_service",
+                         parent=subs[0].trace_ctx, kind=subs[0].kind,
+                         batch=len(sets)) as _sp:
+            n = self._dispatch_bucket_inner(subs, sets, _sp)
+        return n
+
+    def _dispatch_bucket_inner(self, subs, sets, _sp) -> int:
         device, host = self._bls_fns()
         t0 = self._clock()
+        if TRACER.enabled:
+            _sp.set(queue_wait_ms=round(
+                (t0 - min(s.enqueued for s in subs)) * 1e3, 2))
         try:
             ok, path = self.envelope.call(device, host, (sets,))
         except Exception:  # noqa: BLE001 — even a raising HOST path must
@@ -874,6 +917,7 @@ class VerificationService:
                 sample if self._ewma_dispatch_s is None
                 else 0.3 * sample + 0.7 * self._ewma_dispatch_s)
         observe("stream_verify_dispatch_seconds", dt)
+        _sp.set(path=path, verdict=bool(ok))
         if ok or len(subs) == 1:
             for s in subs:
                 self._complete(s, bool(ok), path)
@@ -896,7 +940,7 @@ class VerificationService:
                 return
             sub.completed = True
         lat = self._clock() - sub.enqueued
-        self._m_latency.observe(lat)
+        self._m_latency.labels(sub.kind).observe(lat)
         with self._lock:
             self.latencies.append(lat)
             self.counters["verified" if ok else "rejected"] += 1

@@ -1,0 +1,534 @@
+"""Slot-scope tracing — unified spans from gossip arrival to head.
+
+The hot path's timings used to live in eight disconnected module-global
+dicts (``LAST_BLOCK_TIMINGS``, ``LAST_EPOCH_TIMINGS``, ``LAST_COLD_
+TIMINGS``, ``LAST_FAST_AGG_TIMINGS``, ``LAST_KZG_TIMINGS``,
+``LAST_PUSH_STATS``, the fast-agg ``STAGE_TIMINGS`` profile and
+``RESIDENCY_STATS``) that only ``bench.py`` knew how to read, and no
+artifact showed one slot end-to-end.  This module is the one
+instrument:
+
+- :class:`Tracer` — a low-overhead, thread-safe span system.  Spans
+  nest via a thread-local stack; :meth:`Tracer.ctx` captures a
+  :class:`SpanContext` token that another thread adopts with
+  ``span(..., parent=ctx)`` (the BeaconProcessor worker /
+  verification-service pump-thread hops).  A **disabled** tracer is a
+  no-op fast path: ``span()`` returns a shared singleton after one
+  attribute check, and every call site that would compute arguments
+  first guards on ``TRACER.enabled``.
+- **Slot traces** — every completed span lands in the per-slot trace of
+  its resolved slot (explicit argument > parent's slot > the ambient
+  slot the chain sets from ``per_slot_task``).  A ring buffer keeps the
+  last N fully-assembled slots (``LIGHTHOUSE_TPU_TRACE_RING``,
+  default 64).
+- **Chrome trace-event export** — :meth:`Tracer.chrome_trace` emits the
+  ``{"traceEvents": [...]}`` JSON that opens directly in Perfetto /
+  ``chrome://tracing`` (``ph:"X"`` duration events on real thread
+  tracks, ``ph:"i"`` instants for gossip-arrival stamps and breaker
+  transitions).
+- **The stage adapter** — :func:`stage_split` snapshots any of the
+  legacy stage dicts by name (ONE read surface: bench.py's
+  ``block_phase_split`` / ``epoch`` / ``bls_stage_split`` rows read
+  through it), and :func:`record_stages` converts the same dict into
+  child spans of the current span, laid out back-to-back ending at the
+  call instant — so the per-phase decomposition appears inside the slot
+  trace instead of a parallel reporting channel.
+
+Knobs:
+
+====================================  ======================================
+``LIGHTHOUSE_TPU_TRACE``              ``1`` enables tracing at import
+``LIGHTHOUSE_TPU_TRACE_RING``         slot traces kept (default 64)
+====================================  ======================================
+
+Surfaced by ``/lighthouse/tracing/slots`` +
+``/lighthouse/tracing/slot/{slot}[?format=chrome_trace]`` (HTTP API) and
+``scripts/trace_slot.py`` (the CI-able completeness check).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional
+
+from .metrics import REGISTRY
+
+# The per-slot pipeline stages a fully-assembled trace must cover —
+# span categories, used by the completeness check (`scripts/
+# trace_slot.py` exits 1 when one is missing).
+PIPELINE_STAGES = (
+    "gossip_arrival",          # network/: arrival stamps
+    "verification_service",    # dispatch/envelope/breaker
+    "block_import",            # gossip verify → import pipeline
+    "state_transition",        # per-slot/per-block/per-epoch phases
+    "fork_choice",             # on_block + deltas/apply/find_head
+    "head",                    # head recompute / swap
+)
+
+# Spans kept per slot trace before truncation (a hostile gossip flood
+# must not grow a slot's trace unboundedly).
+MAX_SPANS_PER_SLOT = 8192
+
+
+class SpanContext:
+    """Cross-thread propagation token: enough to parent a span created
+    on another thread under the capturing span (id + slot scope)."""
+
+    __slots__ = ("span_id", "slot")
+
+    def __init__(self, span_id: int, slot: int):
+        self.span_id = span_id
+        self.slot = slot
+
+
+class _NoopSpan:
+    """Shared no-op returned by a disabled tracer — zero allocation on
+    the hot path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def ctx(self) -> Optional[SpanContext]:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """A live span (context manager).  Entering pushes it on the
+    thread-local stack; exiting records it into its slot's trace."""
+
+    __slots__ = ("_tracer", "name", "cat", "slot", "attrs", "span_id",
+                 "parent_id", "t0", "_entered")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, slot: int,
+                 parent_id: int, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.slot = slot
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.span_id = next(tracer._ids)
+        self.t0 = 0.0
+        self._entered = False
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def ctx(self) -> SpanContext:
+        return SpanContext(self.span_id, self.slot)
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.perf_counter()
+        self._tracer._stack().append(self)
+        self._entered = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self.t0
+        stack = self._tracer._stack()
+        if self._entered and stack and stack[-1] is self:
+            stack.pop()
+        elif self._entered and self in stack:  # out-of-order exit
+            stack.remove(self)
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._record(self.slot, {
+            "id": self.span_id, "parent": self.parent_id,
+            "name": self.name, "cat": self.cat,
+            "ts_us": round(self.t0 * 1e6, 1),
+            "dur_us": round(dur * 1e6, 1),
+            "tid": threading.get_ident(),
+            "thread": threading.current_thread().name,
+            "attrs": self.attrs,
+        })
+        return False
+
+
+class Tracer:
+    """Process tracer.  One instance (:data:`TRACER`) serves the whole
+    node; everything here is safe under concurrent span completion from
+    gossip handlers, processor workers, pump threads and the HTTP API
+    reading traces."""
+
+    def __init__(self, max_slots: Optional[int] = None):
+        self.enabled = os.environ.get("LIGHTHOUSE_TPU_TRACE", "0") \
+            in ("1", "true", "yes")
+        try:
+            ring = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "64"))
+        except ValueError:
+            ring = 64
+        self.max_slots = max(1, max_slots if max_slots is not None else ring)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._ids = itertools.count(1)
+        self._slots: "OrderedDict[int, dict]" = OrderedDict()
+        self._ambient_slot = 0
+        self.evicted_slots = 0
+        self.dropped_stale = 0  # spans for slots older than the ring
+        self._m_spans = None  # lazy labeled histogram family
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def enable(self, ring: Optional[int] = None) -> None:
+        if ring is not None:
+            self.max_slots = max(1, int(ring))
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots.clear()
+            self.evicted_slots = 0
+            self.dropped_stale = 0
+
+    # -- slot scope ----------------------------------------------------------
+
+    def set_slot(self, slot: int) -> None:
+        """Ambient slot: spans with no explicit/inherited slot attribute
+        land in this slot's trace.  The chain's per-slot task calls this
+        at every tick; an int store, cheap enough to run unconditionally."""
+        self._ambient_slot = int(slot)
+
+    def current_slot(self) -> int:
+        return self._ambient_slot
+
+    # -- span creation -------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def span(self, name: str, cat: str = "", slot: Optional[int] = None,
+             parent: Optional[SpanContext] = None, **attrs):
+        """Open a span.  ``parent`` (a :class:`SpanContext`) adopts a
+        span captured on another thread; otherwise the parent is the
+        thread's innermost open span."""
+        if not self.enabled:
+            return _NOOP
+        stack = self._stack()
+        top = stack[-1] if stack else None
+        if parent is not None:
+            # Context adoption is CAUSAL parenting, not temporal
+            # nesting: the parent may have exited before this span
+            # starts (submit → async dispatch).  Mark it so trace
+            # consumers don't assume interval containment.
+            parent_id = parent.span_id
+            inherited = parent.slot
+            attrs = {"adopted": True, **attrs}
+        elif top is not None:
+            parent_id = top.span_id
+            inherited = top.slot
+        else:
+            parent_id = 0
+            inherited = self._ambient_slot
+        return Span(self, name, cat,
+                    inherited if slot is None else int(slot),
+                    parent_id, attrs)
+
+    def instant(self, name: str, cat: str = "",
+                slot: Optional[int] = None, **attrs) -> None:
+        """Zero-duration marker (gossip arrival stamps, breaker
+        transitions).  Callers computing arguments should guard on
+        ``TRACER.enabled`` first."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        top = stack[-1] if stack else None
+        self._record(
+            (top.slot if top is not None else self._ambient_slot)
+            if slot is None else int(slot),
+            {"id": next(self._ids),
+             "parent": top.span_id if top is not None else 0,
+             "name": name, "cat": cat,
+             "ts_us": round(time.perf_counter() * 1e6, 1),
+             "dur_us": 0.0, "inst": True,
+             "tid": threading.get_ident(),
+             "thread": threading.current_thread().name,
+             "attrs": attrs})
+
+    def ctx(self) -> SpanContext:
+        """Capture the current position for another thread (innermost
+        open span, or the bare ambient slot)."""
+        stack = self._stack()
+        if stack:
+            return stack[-1].ctx()
+        return SpanContext(0, self._ambient_slot)
+
+    # -- recording -----------------------------------------------------------
+
+    def _record(self, slot: int, rec: dict) -> None:
+        with self._lock:
+            bucket = self._slots.get(slot)
+            if bucket is None:
+                if len(self._slots) >= self.max_slots \
+                        and slot < min(self._slots):
+                    # A straggler span for a slot already behind the
+                    # ring (e.g. a late streamed verdict whose context
+                    # points >ring slots back): drop it outright — a
+                    # fresh bucket would just self-evict and churn.
+                    self.dropped_stale += 1
+                    return
+                bucket = self._slots[slot] = {
+                    "slot": slot, "spans": [], "truncated": 0,
+                    # Aggregates maintained at record time so the slot
+                    # summary never scans/copies span lists under the
+                    # tracer lock (the lock every hot-path span exit
+                    # takes).
+                    "t0": rec["ts_us"], "t1": 0.0, "cats": set()}
+                while len(self._slots) > self.max_slots:
+                    self._slots.pop(min(self._slots))
+                    self.evicted_slots += 1
+            if len(bucket["spans"]) >= MAX_SPANS_PER_SLOT:
+                bucket["truncated"] += 1
+                return
+            bucket["spans"].append(rec)
+            bucket["t0"] = min(bucket["t0"], rec["ts_us"])
+            bucket["t1"] = max(bucket["t1"],
+                               rec["ts_us"] + rec["dur_us"])
+            if rec["cat"]:
+                bucket["cats"].add(rec["cat"])
+        cat = rec.get("cat")
+        if cat and not rec.get("inst"):
+            if self._m_spans is None:
+                self._m_spans = REGISTRY.histogram(
+                    "tracing_span_seconds", "span duration by category",
+                    labelnames=("cat",))
+            self._m_spans.labels(cat).observe(rec["dur_us"] / 1e6)
+
+    # -- stage-dict adapter --------------------------------------------------
+
+    def stage_split(self, source: str) -> dict:
+        """Snapshot one of the legacy stage dicts by name — the ONE read
+        surface bench.py and the trace adapter share (see
+        :data:`_STAGE_SOURCES` for the names)."""
+        return dict(_STAGE_SOURCES[source]())
+
+    def record_stages(self, source: str, cat: Optional[str] = None) -> None:
+        """Convert ``source``'s stage dict into child spans of the
+        current span.  The dicts carry durations, not start offsets, so
+        children are laid out back-to-back ENDING at the call instant
+        (they record sequential phase decompositions, so the layout is
+        faithful).  Non-``*_ms`` keys become attributes on the parent."""
+        if not self.enabled:
+            return
+        snap = self.stage_split(source)
+        if not snap:
+            return
+        stack = self._stack()
+        top = stack[-1] if stack else None
+        parent_id = top.span_id if top is not None else 0
+        slot = top.slot if top is not None else self._ambient_slot
+        if cat is None:
+            cat = top.cat if top is not None and top.cat else "stage"
+        tid = threading.get_ident()
+        tname = threading.current_thread().name
+        # "total_ms" is the sum of the others (the dicts' convention) —
+        # emitting it as a sibling would double the laid-out time.
+        ms = [(k, float(v)) for k, v in snap.items()
+              if k.endswith("_ms") and k != "total_ms"
+              and isinstance(v, (int, float))]
+        other = {k: v for k, v in snap.items() if not k.endswith("_ms")}
+        now = time.perf_counter()
+        t = now - sum(v for _, v in ms) / 1e3
+        for k, v in ms:
+            self._record(slot, {
+                "id": next(self._ids), "parent": parent_id,
+                "name": f"{source}:{k[:-3]}", "cat": cat,
+                "ts_us": round(t * 1e6, 1),
+                "dur_us": round(v * 1e3, 1),
+                "tid": tid, "thread": tname,
+                "attrs": {"source": source}})
+            t += v / 1e3
+        if other and top is not None:
+            top.set(**{f"{source}_{k}": v for k, v in other.items()})
+
+    # -- device residency attribution ---------------------------------------
+
+    def residency_mark(self) -> Optional[dict]:
+        """Snapshot ``RESIDENCY_STATS`` for delta attribution (pair with
+        :meth:`record_residency`)."""
+        if not self.enabled:
+            return None
+        from ..ops.device_tree import residency_snapshot
+        return residency_snapshot()
+
+    def record_residency(self, span, mark: Optional[dict]) -> None:
+        """Attach the device push/pull byte deltas since ``mark`` to
+        ``span`` — the device-stage attribution of a transition."""
+        if mark is None or not self.enabled:
+            return
+        from ..ops.device_tree import residency_snapshot
+        after = residency_snapshot()
+        delta = {f"residency_{k}": after[k] - mark[k]
+                 for k in mark if after.get(k, 0) != mark[k]}
+        if delta:
+            span.set(**delta)
+
+    # -- export --------------------------------------------------------------
+
+    def slots(self) -> List[int]:
+        with self._lock:
+            return sorted(self._slots)
+
+    def slot_summaries(self) -> List[dict]:
+        # Reads only the per-bucket aggregates maintained at record
+        # time — O(ring) under the lock, never a span-list scan/copy.
+        with self._lock:
+            out = [{
+                "slot": b["slot"],
+                "spans": len(b["spans"]),
+                "truncated": b["truncated"],
+                "wall_ms": round(max(b["t1"] - b["t0"], 0.0) / 1e3, 3),
+                "stages": sorted(b["cats"]),
+            } for b in self._slots.values()]
+        out.sort(key=lambda r: r["slot"])
+        return out
+
+    def slot_trace(self, slot: int) -> Optional[dict]:
+        with self._lock:
+            bucket = self._slots.get(int(slot))
+            if bucket is None:
+                return None
+            spans = list(bucket["spans"])
+            truncated = bucket["truncated"]
+        spans.sort(key=lambda s: s["ts_us"])
+        return {"slot": int(slot), "truncated": truncated,
+                "missing_stages": self._missing(spans), "spans": spans}
+
+    @staticmethod
+    def _missing(spans: List[dict]) -> List[str]:
+        present = {s["cat"] for s in spans}
+        return [st for st in PIPELINE_STAGES if st not in present]
+
+    def missing_stages(self, slot: int) -> List[str]:
+        """Pipeline stages absent from ``slot``'s trace (empty = the
+        trace covers gossip → head).  A slot never traced reports every
+        stage missing."""
+        trace = self.slot_trace(slot)
+        if trace is None:
+            return list(PIPELINE_STAGES)
+        return trace["missing_stages"]
+
+    def chrome_trace(self, slot: int) -> Optional[dict]:
+        """Chrome trace-event JSON (Perfetto / chrome://tracing).  One
+        pid (the node), real thread tracks, ``X`` duration events and
+        ``i`` instants."""
+        trace = self.slot_trace(slot)
+        if trace is None:
+            return None
+        events: List[dict] = []
+        threads: Dict[int, str] = {}
+        for s in trace["spans"]:
+            threads.setdefault(s["tid"], s["thread"])
+        for tid, tname in sorted(threads.items()):
+            events.append({"ph": "M", "pid": 0, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": tname}})
+        for s in trace["spans"]:
+            args = {"slot": trace["slot"], "span_id": s["id"],
+                    "parent_id": s["parent"], **s["attrs"]}
+            if s.get("inst"):
+                events.append({"ph": "i", "pid": 0, "tid": s["tid"],
+                               "name": s["name"], "cat": s["cat"] or "-",
+                               "ts": s["ts_us"], "s": "t", "args": args})
+            else:
+                events.append({"ph": "X", "pid": 0, "tid": s["tid"],
+                               "name": s["name"], "cat": s["cat"] or "-",
+                               "ts": s["ts_us"], "dur": s["dur_us"],
+                               "args": args})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metadata": {"slot": trace["slot"],
+                             "truncated": trace["truncated"],
+                             "tool": "lighthouse-tpu tracing"}}
+
+
+# ---------------------------------------------------------------------------
+# Stage-dict source registry (lazy imports: tracing must stay cheap to
+# import and cycle-free — the sources import tracing, not vice versa).
+# ---------------------------------------------------------------------------
+
+def _src_block() -> dict:
+    from ..state_transition.per_block import LAST_BLOCK_TIMINGS
+    return LAST_BLOCK_TIMINGS
+
+
+def _src_epoch() -> dict:
+    from ..state_transition.per_epoch import LAST_EPOCH_TIMINGS
+    return LAST_EPOCH_TIMINGS
+
+
+def _src_cold_merkle() -> dict:
+    from ..types.validators import LAST_COLD_TIMINGS
+    return LAST_COLD_TIMINGS
+
+
+def _src_leaf_push() -> dict:
+    from ..ops.merkle_kernel import LAST_PUSH_STATS
+    return LAST_PUSH_STATS
+
+
+def _src_fast_agg() -> dict:
+    from ..crypto.tpu_backend import LAST_FAST_AGG_TIMINGS
+    return LAST_FAST_AGG_TIMINGS
+
+
+def _src_kzg() -> dict:
+    from ..kzg.device import LAST_KZG_TIMINGS
+    return LAST_KZG_TIMINGS
+
+
+def _src_bls_kernels() -> dict:
+    from ..crypto.profiling import LAST_STAGE_PROFILE
+    return LAST_STAGE_PROFILE
+
+
+def _src_residency() -> dict:
+    from ..ops.device_tree import RESIDENCY_STATS
+    return RESIDENCY_STATS
+
+
+_STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
+    "block": _src_block,
+    "epoch": _src_epoch,
+    "cold_merkle": _src_cold_merkle,
+    "leaf_push": _src_leaf_push,
+    "fast_agg": _src_fast_agg,
+    "kzg": _src_kzg,
+    "bls_kernels": _src_bls_kernels,
+    "residency": _src_residency,
+}
+
+
+def register_stage_source(name: str, getter: Callable[[], dict]) -> None:
+    """Extension point (tests, future subsystems): add a named stage
+    dict to the adapter."""
+    _STAGE_SOURCES[name] = getter
+
+
+# The process tracer + module-level conveniences.
+TRACER = Tracer()
+
+span = TRACER.span
+instant = TRACER.instant
+set_slot = TRACER.set_slot
+record_stages = TRACER.record_stages
+stage_split = TRACER.stage_split

@@ -25,6 +25,12 @@ from __future__ import annotations
 import time
 from typing import Dict
 
+# Most recent :func:`profile_stages` output — the adapter-readable twin
+# (``common.tracing.stage_split("bls_kernels")``) of the other LAST_*
+# stage dicts, so bench.py's ``bls_stage_split`` row reads through the
+# same surface as the tracer.
+LAST_STAGE_PROFILE: Dict[str, float] = {}
+
 
 def profile_stages(n: int = 10, C: int = 2) -> Dict[str, float]:
     """ms/call per pipeline stage at the C-chunk (C·128-lane) shape."""
@@ -73,4 +79,6 @@ def profile_stages(n: int = 10, C: int = 2) -> Dict[str, float]:
         out[f"stage_{name}_ms"] = round(
             (time.perf_counter() - t0) * 1e3 / n, 2)
     out["stage_shape"] = f"C={C} ({C * S} lanes), K=1"
+    LAST_STAGE_PROFILE.clear()
+    LAST_STAGE_PROFILE.update(out)
     return out

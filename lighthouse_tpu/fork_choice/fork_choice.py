@@ -15,6 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 import numpy as np
 
+from ..common.tracing import TRACER
 from .proto_array import (
     EXEC_IRRELEVANT,
     EXEC_OPTIMISTIC,
@@ -186,23 +187,35 @@ class ForkChoice:
 
     def get_head(self) -> bytes:
         """`fork_choice.rs:528` → `proto_array.find_head`."""
-        self._drain_queued()
-        # Justified balances: active validators AT the justified epoch,
-        # from the justified state (`JustifiedBalances::from_justified_state`).
-        balances = _active_balances(self.justified_state,
-                                    self.justified_checkpoint[0])
-        deltas = self.proto.compute_deltas(balances)
-        boost_score = 0
-        if self.proposer_boost_root != ZERO_ROOT:
-            committee_weight = (int(balances.sum())
-                                // self.preset.SLOTS_PER_EPOCH)
-            boost_score = (committee_weight
-                           * self.spec.proposer_score_boost // 100)
-        self.proto.apply_score_changes(
-            deltas, self.justified_checkpoint, self.finalized_checkpoint,
-            self.proposer_boost_root, boost_score, self.current_slot)
-        return self.proto.find_head(self.justified_checkpoint[1],
-                                    self.current_slot)
+        with TRACER.span("fork_choice_apply", cat="fork_choice",
+                         nodes=len(self.proto.indices)) as _sp:
+            with TRACER.span("drain_votes", cat="fork_choice",
+                             queued=len(self.queued)):
+                self._drain_queued()
+            # Justified balances: active validators AT the justified
+            # epoch, from the justified state
+            # (`JustifiedBalances::from_justified_state`).
+            balances = _active_balances(self.justified_state,
+                                        self.justified_checkpoint[0])
+            with TRACER.span("compute_deltas", cat="fork_choice"):
+                deltas = self.proto.compute_deltas(balances)
+            boost_score = 0
+            if self.proposer_boost_root != ZERO_ROOT:
+                committee_weight = (int(balances.sum())
+                                    // self.preset.SLOTS_PER_EPOCH)
+                boost_score = (committee_weight
+                               * self.spec.proposer_score_boost // 100)
+            with TRACER.span("apply_scores", cat="fork_choice"):
+                self.proto.apply_score_changes(
+                    deltas, self.justified_checkpoint,
+                    self.finalized_checkpoint,
+                    self.proposer_boost_root, boost_score,
+                    self.current_slot)
+            with TRACER.span("find_head", cat="fork_choice"):
+                head = self.proto.find_head(self.justified_checkpoint[1],
+                                            self.current_slot)
+            _sp.set(head=head.hex())
+            return head
 
     # -- optimistic sync hooks ----------------------------------------------
 

@@ -24,6 +24,7 @@ from enum import Enum
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..common.metrics import REGISTRY
+from ..common.tracing import TRACER
 
 
 class WorkType(str, Enum):
@@ -169,7 +170,9 @@ class BeaconProcessor:
                         time.sleep(min(t, 0.05))
                         continue
                 break
-            ev.process_fn(ev.payload)
+            with TRACER.span("work_event", cat="processor",
+                             work=ev.work_type.value):
+                ev.process_fn(ev.payload)
             self._m_processed.inc()
             processed += 1
         return processed
@@ -231,7 +234,9 @@ class BeaconProcessor:
 
     def _run_one(self, ev: WorkEvent) -> None:
         try:
-            ev.process_fn(ev.payload)
+            with TRACER.span("work_event", cat="processor",
+                             work=ev.work_type.value):
+                ev.process_fn(ev.payload)
             self._m_processed.inc()
         finally:
             with self._lock:

@@ -23,6 +23,7 @@ from ..beacon_chain import (
     ParentUnknown,
 )
 from ..common.logging import Logger, test_logger
+from ..common.tracing import TRACER
 from .beacon_processor import BeaconProcessor, WorkEvent, WorkType
 
 # Gossip topic names (`lighthouse_network/src/types/topics.rs:11-26`).
@@ -240,11 +241,19 @@ class NetworkNode:
     # -- gossip handlers → processor queues ----------------------------------
 
     def _on_gossip_block(self, signed_block) -> None:
+        if TRACER.enabled:  # arrival stamp: where the slot trace begins
+            TRACER.instant("gossip_arrival", cat="gossip_arrival",
+                           slot=int(signed_block.message.slot),
+                           kind="block", node=self.name)
         self.processor.submit(WorkEvent(
             WorkType.GossipBlock, signed_block, self._process_block))
 
     def _on_gossip_attestation(self, atts: List) -> None:
         """Aggregate-topic traffic: never shed by the verify service."""
+        if TRACER.enabled and atts:
+            TRACER.instant("gossip_arrival", cat="gossip_arrival",
+                           slot=int(atts[0].data.slot), kind="aggregate",
+                           count=len(atts), node=self.name)
         for att in atts:
             self.processor.submit(WorkEvent(
                 WorkType.GossipAggregateBatch, att,
@@ -253,12 +262,23 @@ class NetworkNode:
     def _on_gossip_subnet_attestation(self, atts: List) -> None:
         """Subnet (unaggregated) traffic: the sheddable class — under
         overload these degrade FIRST, never aggregates or blocks."""
+        if TRACER.enabled and atts:
+            TRACER.instant("gossip_arrival", cat="gossip_arrival",
+                           slot=int(atts[0].data.slot),
+                           kind="attestation", count=len(atts),
+                           node=self.name)
         for att in atts:
             self.processor.submit(WorkEvent(
                 WorkType.GossipAttestationBatch, att,
                 self._process_attestation_batch))
 
     def _on_gossip_blob_sidecar(self, sidecar) -> None:
+        if TRACER.enabled:
+            TRACER.instant(
+                "gossip_arrival", cat="gossip_arrival",
+                slot=int(sidecar.signed_block_header.message.slot),
+                kind="blob_sidecar", index=int(sidecar.index),
+                node=self.name)
         self.processor.submit(WorkEvent(
             WorkType.GossipBlobSidecar, sidecar,
             self._process_blob_sidecar))

@@ -159,6 +159,11 @@ def process_block(state, signed_block, fork: ForkName, preset, spec, T,
     t0 = _phase("sync_aggregate_ms", t0)
     acc.finish()
     _phase("signature_verify_ms", t0)
+    # Stage adapter (common/tracing): the SAME dict bench.py reads as
+    # `block_phase_split` becomes child spans of the enclosing
+    # state-transition span — one source, two surfaces.
+    from ..common.tracing import TRACER
+    TRACER.record_stages("block", cat="state_transition")
 
 
 def process_block_header(state, block, preset, T) -> None:

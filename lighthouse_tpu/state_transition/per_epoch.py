@@ -632,6 +632,10 @@ def process_epoch_single_pass(state, fork: ForkName, preset, spec,
     timings["total_ms"] = (time.perf_counter() - t_all) * 1e3
     LAST_EPOCH_TIMINGS.clear()
     LAST_EPOCH_TIMINGS.update(timings)
+    # Stage adapter: the epoch decomposition bench.py reads becomes
+    # child spans of the enclosing epoch-transition span.
+    from ..common.tracing import TRACER
+    TRACER.record_stages("epoch", cat="state_transition")
     return summary
 
 

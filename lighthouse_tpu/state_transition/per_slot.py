@@ -8,6 +8,7 @@ boundaries, apply fork upgrades at activation epochs.
 
 from __future__ import annotations
 
+from ..common.tracing import TRACER
 from ..types.chain_spec import ForkName
 from .per_block import SignatureStrategy, process_block
 from .per_epoch import process_epoch
@@ -55,7 +56,10 @@ def process_slots(state, target_slot: int, preset, spec, T,
         if (state.slot + 1) % preset.SLOTS_PER_EPOCH == 0:
             fork = spec.fork_name_at_epoch(
                 state.slot // preset.SLOTS_PER_EPOCH)
-            process_epoch(state, fork, preset, spec, T)
+            with TRACER.span("epoch_transition", cat="state_transition",
+                             epoch=int(state.slot)
+                             // preset.SLOTS_PER_EPOCH + 1):
+                process_epoch(state, fork, preset, spec, T)
         state.slot += 1
         if state.slot % preset.SLOTS_PER_EPOCH == 0:
             epoch = state.slot // preset.SLOTS_PER_EPOCH

@@ -1,0 +1,127 @@
+"""One traced slot, end to end — the shared driver behind
+``scripts/trace_slot.py`` and the tracing test suite.
+
+Drives a single in-process node through one full slot of the real
+pipeline — gossip block arrival → gossip-verify → (streamed) attestation
+verification → state transition → fork-choice apply → head — with the
+tracer enabled, and returns the assembled slot trace.  This is the
+CI-able completeness check for the instrumentation itself: if a future
+refactor drops a pipeline stage's spans, :func:`drive_traced_slot`
+reports it in ``missing_stages``.
+
+The drill toggles the process-global tracer and ambient slot: run it in
+a dedicated process (the script, tests), never inside a live node with
+concurrent traced traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..beacon_chain import BeaconChain
+from ..common.tracing import PIPELINE_STAGES, TRACER
+from ..network import GossipBus, NetworkNode
+from ..state_transition.per_slot import process_slots
+from ..store import HotColdDB
+from ..types.presets import MINIMAL
+
+
+def drive_traced_slot(n_validators: int = 16, n_atts: int = 4,
+                      device: bool = False, ring: int = 8,
+                      ) -> Tuple[dict, dict]:
+    """Run one simulated slot with tracing on.
+
+    Returns ``(trace, info)``: the assembled slot-trace dict (spans +
+    ``missing_stages``) and a small info dict (slot, counters, chrome
+    trace).  ``device=False`` pins the fake BLS backend (host logic
+    only — quick-tier safe); ``device=True`` leaves the configured
+    backend in place so device dispatches are traced for real.
+    """
+    from ..crypto import bls
+    from .harness import StateHarness
+
+    prev_backend = next(
+        k for k, v in bls._BACKENDS.items() if v is bls.get_backend())
+    if not device:
+        bls.set_backend("fake")
+    was_enabled = TRACER.enabled
+    prev_ring = TRACER.max_slots
+    # The drill toggles the PROCESS tracer (off for prep, on for the
+    # drive) and sets the ambient slot through its chain tick — it is a
+    # dedicated-process driver (scripts/trace_slot.py, tests), NOT safe
+    # to run inside a live node with concurrent traced traffic.  An
+    # already-enabled tracer keeps its ring and previously assembled
+    # traces; a previously-disabled one gets the drill's private ring.
+    if not was_enabled:
+        TRACER.reset()
+        TRACER.enable(ring=ring)
+    node = None
+    try:
+        # ALL driver-side prep runs with the tracer state it found the
+        # harness in... specifically: block/attestation BUILDING happens
+        # off-trace, so the artifact holds only the NODE's pipeline —
+        # the harness's own transitions (apply_block, the slot advance
+        # that resolves attestation roots) would otherwise land in the
+        # same slot bucket and multiply the apparent transition cost.
+        TRACER.disable()
+        h = StateHarness(n_validators=n_validators, preset=MINIMAL)
+        hdr = h.state.latest_block_header.copy()
+        hdr.state_root = h.state.tree_hash_root()
+        chain = BeaconChain(
+            store=HotColdDB.memory(h.preset, h.spec, h.T),
+            genesis_state=h.state.copy(),
+            genesis_block_root=hdr.tree_hash_root(),
+            preset=h.preset, spec=h.spec, T=h.T)
+        node = NetworkNode(chain, GossipBus(), name="trace-node")
+
+        slot = 1
+        signed = h.build_block(slot=slot)
+        h.apply_block(signed)
+        adv = process_slots(h.state.copy(), slot + 1, h.preset, h.spec,
+                            h.T)
+        atts = h.attestations_for_slot(adv, slot)[:max(1, n_atts)]
+
+        # The traced section: ONLY the node's real pipeline.
+        TRACER.enable()
+        chain.per_slot_task(slot)  # tick → ambient slot scope
+
+        # Block through the REAL gossip path: arrival stamp → processor
+        # queue → gossip verify → transition → fork choice → head.
+        node._on_gossip_block(signed)
+        node.processor.run_until_idle()
+        assert chain.head.slot == slot, "traced block failed to import"
+
+        # Attestations for the imported block via the subnet gossip
+        # path (the sheddable class → streaming verification service).
+        for att in atts:
+            subnet = int(att.data.index) % 64
+            node.subscribe_subnet(subnet)
+            node.publish_attestation_to_subnet(att, subnet)
+        node.processor.run_until_idle()  # drains the verify service too
+
+        trace = TRACER.slot_trace(slot) or {
+            "slot": slot, "spans": [],
+            "missing_stages": list(PIPELINE_STAGES)}
+        info = {
+            "slot": slot,
+            "n_validators": n_validators,
+            "attestations_published": len(atts),
+            "verify_stats": (chain.verification_service.stats()
+                             if chain.verification_service else {}),
+            "chrome_trace": TRACER.chrome_trace(slot),
+            "summaries": TRACER.slot_summaries(),
+        }
+        return trace, info
+    finally:
+        if node is not None:
+            node.close()
+        TRACER.max_slots = prev_ring
+        # Restore from was_enabled even on a prep exception (prep runs
+        # with the tracer toggled off — an early raise must not leave an
+        # operator-enabled tracer dark for the rest of the process).
+        if was_enabled:
+            TRACER.enable()
+        else:
+            TRACER.disable()
+        if not device:
+            bls.set_backend(prev_backend)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Replay one simulated slot through the full pipeline and emit its
+trace artifact — the CI-able completeness check for the slot-scope
+tracing instrumentation (ISSUE 9).
+
+    JAX_PLATFORMS=cpu python scripts/trace_slot.py --validators 16 \
+        --atts 4 [--device] [--out trace.json]
+
+Drives a single in-process node (fake BLS backend by default; pass
+``--device`` to keep the configured backend and trace real device
+dispatches) through one slot: gossip block arrival → gossip verify →
+streamed attestation verification → state transition (per-phase stage
+spans from the adapter) → fork-choice apply → head.  Prints a per-stage
+summary, optionally writes the Chrome trace-event JSON (open it in
+Perfetto / chrome://tracing), and **exits 1 if the assembled trace is
+missing any required pipeline stage** — the guard that keeps the
+instrumentation honest as the code under it evolves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--validators", type=int, default=16)
+    ap.add_argument("--atts", type=int, default=4,
+                    help="attestations gossiped through the streaming "
+                         "verify path")
+    ap.add_argument("--device", action="store_true",
+                    help="keep the configured BLS backend (trace real "
+                         "device dispatches; cold compiles may take "
+                         "minutes — warm .jax_cache first)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the Chrome trace-event JSON here "
+                         "(opens directly in Perfetto)")
+    ap.add_argument("--ring", type=int, default=8,
+                    help="slot-trace ring size while driving")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.common.tracing import PIPELINE_STAGES
+    from lighthouse_tpu.testing.trace_drill import drive_traced_slot
+
+    trace, info = drive_traced_slot(
+        n_validators=args.validators, n_atts=args.atts,
+        device=args.device, ring=args.ring)
+
+    spans = trace["spans"]
+    by_id = {s["id"]: s for s in spans}
+    by_cat: dict = {}
+    for s in spans:
+        cat = s["cat"] or "-"
+        agg = by_cat.setdefault(cat, {"spans": 0, "ms": 0.0})
+        agg["spans"] += 1
+        # Only category-ENTRY spans contribute time (a child whose
+        # parent is in the same category is already inside its
+        # parent's interval — summing both would exceed wall time).
+        parent = by_id.get(s["parent"])
+        if parent is None or (parent["cat"] or "-") != cat:
+            agg["ms"] += s["dur_us"] / 1e3
+    print(f"slot {trace['slot']}: {len(spans)} spans "
+          f"({info['attestations_published']} attestations streamed, "
+          f"{args.validators} validators)")
+    for cat in sorted(by_cat):
+        agg = by_cat[cat]
+        print(f"  {cat:<22} {agg['spans']:>4} spans  "
+              f"{agg['ms']:>9.2f} ms (summed)")
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(info["chrome_trace"], f)
+        print(f"chrome trace written to {args.out} "
+              f"({len(info['chrome_trace']['traceEvents'])} events) — "
+              "open in Perfetto / chrome://tracing")
+
+    missing = trace["missing_stages"]
+    if missing:
+        print(f"INCOMPLETE TRACE: missing pipeline stages {missing} "
+              f"(required: {list(PIPELINE_STAGES)})", file=sys.stderr)
+        return 1
+    print("trace complete: all required pipeline stages present "
+          f"({list(PIPELINE_STAGES)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
