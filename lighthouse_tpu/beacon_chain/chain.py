@@ -136,6 +136,12 @@ class BeaconChain:
         self.head = CanonicalHead(root=genesis_block_root,
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
+        self.last_recovery = None
+        # Anchor snapshot: a process killed before its first finalization
+        # must still find a resumable chain in the datadir; every later
+        # import's journal entry replays on top of this.
+        self._persisted_finalized = self.fork_choice.finalized_checkpoint
+        self.persist()
 
     # -- restart persistence -------------------------------------------------
 
@@ -143,34 +149,59 @@ class BeaconChain:
         """Persist fork choice + op pool + chain metadata so a restarted
         process resumes with the identical head and pending operations
         (`persisted_fork_choice.rs`, `operation_pool/src/persistence.rs`,
-        `persisted_beacon_chain.rs`)."""
+        `persisted_beacon_chain.rs`).  ONE atomic batch that also clears
+        the import journal: after a successful persist the journal holds
+        exactly the imports newer than this snapshot — the restart
+        replay window."""
+        from ..common.metrics import REGISTRY
         from ..fork_choice.persistence import encode_fork_choice
         from ..op_pool.persistence import encode_op_pool
-        self.store.kv.do_atomically([
-            ("put", DBColumn.ForkChoice, b"fork_choice",
-             encode_fork_choice(self.fork_choice)),
-            ("put", DBColumn.OpPool, b"op_pool",
-             encode_op_pool(self.op_pool, self.T)),
-            ("put", DBColumn.BeaconChain, b"genesis",
-             self.genesis_block_root + self.genesis_state_root),
-        ])
+        ops = [
+            self.store.item_put_op(DBColumn.ForkChoice, b"fork_choice",
+                                   encode_fork_choice(self.fork_choice)),
+            self.store.item_put_op(DBColumn.OpPool, b"op_pool",
+                                   encode_op_pool(self.op_pool, self.T)),
+            self.store.item_put_op(DBColumn.BeaconChain, b"genesis",
+                                   self.genesis_block_root
+                                   + self.genesis_state_root),
+        ]
+        ops.extend(self.store.journal_clear_ops())
+        self.store.do_atomically(ops)
+        REGISTRY.counter(
+            "store_persist_total",
+            "fork-choice/op-pool snapshot persists").inc()
 
     @classmethod
     def resume(cls, *, store: HotColdDB, preset, spec, T, slot_clock=None):
         """Rebuild a chain from a persisted store (restart path — the
         `ClientBuilder.build_beacon_chain` resume branch,
-        `client/src/builder.rs:850`)."""
+        `client/src/builder.rs:850`), self-healing: the store is
+        CRC-verified (corrupt rows quarantined), the persisted
+        fork-choice snapshot is reconciled against the block columns,
+        and every import journaled after the snapshot replays — so a
+        SIGKILL'd node restarts on exactly the head it died with
+        (:mod:`..store.recovery`)."""
+        from ..common.metrics import REGISTRY
+        from ..fork_choice import ForkChoice
         from ..fork_choice.persistence import decode_fork_choice
         from ..op_pool.persistence import decode_op_pool
+        from ..store import StoreCorruption
+        from ..store.recovery import reconcile, verify_and_quarantine
 
+        report = verify_and_quarantine(store)
         meta = store.get_item(DBColumn.BeaconChain, b"genesis")
-        fc_blob = store.get_item(DBColumn.ForkChoice, b"fork_choice")
-        pool_blob = store.get_item(DBColumn.OpPool, b"op_pool")
-        if meta is None or fc_blob is None:
+        if meta is None:
+            if any(q.column is DBColumn.BeaconChain
+                   for q in report.quarantined):
+                raise StoreCorruption(
+                    "the persisted chain metadata is corrupt — this "
+                    "datadir cannot be resumed; restore from a backup or "
+                    "boot from a checkpoint", DBColumn.BeaconChain,
+                    b"genesis")
             raise BlockError("store holds no persisted chain")
         genesis_root, genesis_state_root = meta[:32], meta[32:64]
-        fc = decode_fork_choice(fc_blob, preset=preset, spec=spec,
-                                justified_state=None)
+        fc_blob = store.get_item(DBColumn.ForkChoice, b"fork_choice")
+        pool_blob = store.get_item(DBColumn.OpPool, b"op_pool")
 
         def _post_state_of(block_root: bytes):
             if block_root == genesis_root:
@@ -180,10 +211,31 @@ class BeaconChain:
                 return None
             return store.get_state(bytes(block.message.state_root))
 
-        jstate = _post_state_of(fc.justified_checkpoint[1])
-        if jstate is None:
-            raise BlockError("justified state missing from store")
-        fc.justified_state = jstate
+        if fc_blob is None:
+            # The snapshot itself was lost/quarantined: rebuild fork
+            # choice from the genesis anchor and let the reconciliation
+            # pass replay every stored block (cold + hot) in slot order.
+            genesis_state = store.get_state(genesis_state_root)
+            if genesis_state is None:
+                raise StoreCorruption(
+                    "fork-choice snapshot AND genesis state are gone — "
+                    "restore the datadir from a backup or resync",
+                    DBColumn.BeaconState, genesis_state_root)
+            fc = ForkChoice(preset, spec, genesis_root=genesis_root,
+                            genesis_state=genesis_state.copy())
+            report.rebuilt_fork_choice = True
+            report.notes.append("fork-choice blob missing/corrupt: "
+                                "rebuilt by full block replay")
+        else:
+            fc = decode_fork_choice(fc_blob, preset=preset, spec=spec,
+                                    justified_state=None)
+            jstate = _post_state_of(fc.justified_checkpoint[1])
+            if jstate is None:
+                raise StoreCorruption(
+                    "justified state missing from store — restore the "
+                    "datadir from a backup or resync",
+                    DBColumn.BeaconState, fc.justified_checkpoint[1])
+            fc.justified_state = jstate
 
         chain = cls.__new__(cls)
         chain.store = store
@@ -219,10 +271,27 @@ class BeaconChain:
         chain.lc_optimistic_update = None
         chain.lc_finality_update = None
         chain.lc_period_update = None
+        chain._persisted_finalized = fc.finalized_checkpoint
+        # Reconcile snapshot vs store and replay the post-snapshot
+        # import window BEFORE computing the head.
+        reconcile(store, chain, report, genesis_root=genesis_root)
+        chain.last_recovery = report
+        if report.replayed:
+            REGISTRY.counter(
+                "store_recovery_replayed_blocks",
+                "journaled imports replayed on restart").inc(
+                    len(report.replayed))
         head_root = fc.get_head()
         head_state = _post_state_of(head_root)
         if head_state is None:
-            raise BlockError("head state missing from store")
+            # NOT BlockError: cli.py treats BlockError as "virgin
+            # datadir" and would construct a fresh chain whose __init__
+            # persist() overwrites the snapshot + clears the journal —
+            # destroying the very bytes a restore needs.
+            raise StoreCorruption(
+                "head state missing from store (quarantined or lost) — "
+                "restore the datadir from a backup or resync from a "
+                "checkpoint", DBColumn.BeaconState, head_root)
         chain._states_by_block[head_root] = head_state.copy()
         # Post-state slot == block slot (and covers a genesis head, which
         # has no stored block).
@@ -230,6 +299,10 @@ class BeaconChain:
                                    slot=int(head_state.slot),
                                    state=head_state)
         return chain
+
+    # Reference-style name for the restart path (`from_store` in the
+    # issue/survey nomenclature): identical to :meth:`resume`.
+    from_store = resume
 
     @classmethod
     def from_checkpoint(cls, *, store: HotColdDB, anchor_state,
@@ -474,12 +547,23 @@ class BeaconChain:
         state = ex.post_state
         state_root = bytes(ex.signed_block.message.state_root)
         with TRACER.span("store_put", cat="block_import"):
-            self.store.put_block(block_root, ex.signed_block)
-            self.store.put_state(state_root, state.copy(), block_root)
-            # Persist the availability-gate sidecars alongside the block
-            # (served by blob_sidecars_by_range/by_root + the HTTP API).
+            # ONE atomic batch per import: block + state/summary + the
+            # availability-gate sidecars (served by blob_sidecars_by_
+            # range/by_root + the HTTP API) + a journal entry bounding
+            # the restart replay window.  A crash anywhere leaves either
+            # the whole import or none of it — never a block without its
+            # state or a state without its journal record.
+            ops = self.store.block_put_ops(block_root, ex.signed_block)
+            ops += self.store.state_put_ops(state_root, state.copy(),
+                                            block_root)
             for sc in self.data_availability.take_sidecars(block_root):
-                self.store.put_blob_sidecar(block_root, int(sc.index), sc)
+                ops += self.store.blob_put_ops(block_root, int(sc.index),
+                                               sc)
+            ops.append(self.store.journal_put_op(
+                block_root, int(ex.signed_block.message.slot),
+                bytes(ex.signed_block.message.parent_root)))
+            self.store.do_atomically(ops)
+            TRACER.record_stages("store")
         with TRACER.span("fork_choice_on_block", cat="fork_choice"):
             self.fork_choice.on_block(ex.signed_block, block_root, state,
                                       is_timely=is_timely)
@@ -497,23 +581,7 @@ class BeaconChain:
                 entry)
         # Feed block attestations to fork choice (`beacon_chain.rs:
         # apply_attestation_to_fork_choice` via import).
-        from .attestation_verification import attesting_indices
-        resolved = []
-        for att in ex.signed_block.message.body.attestations:
-            try:
-                idx, _committee = attesting_indices(state, att, self.preset)
-                resolved.append((int(att.data.slot), idx.tolist()))
-                indexed = _Indexed(att.data, idx.tolist())
-                # Slasher BEFORE fork choice: an attestation naming an
-                # unknown head block (orphaned branch — the very shape a
-                # double vote takes) raises below, and must still be
-                # ingested for detection.
-                if self.slasher is not None:
-                    self.slasher.accept_attestation(indexed)
-                self.fork_choice.on_attestation(indexed,
-                                                is_from_block=True)
-            except Exception:
-                pass  # block attestations are best-effort for fork choice
+        resolved = self._feed_block_attestations(ex.signed_block, state)
         if self.validator_monitor is not None:
             self.validator_monitor.process_block(
                 ex.signed_block.message, resolved, state)
@@ -537,7 +605,47 @@ class BeaconChain:
             for root in [r for r, s in self._states_by_block.items()
                          if int(s.slot) < fin_slot - 1]:
                 del self._states_by_block[root]
+        # Fork-choice/op-pool snapshots persist on EVERY finalization
+        # advance (not only at shutdown): the crash-replay window is
+        # bounded to the imports since the last finalized checkpoint.
+        if self.fork_choice.finalized_checkpoint != \
+                getattr(self, "_persisted_finalized", None):
+            self._persisted_finalized = self.fork_choice.finalized_checkpoint
+            self.persist()
         self.op_pool.prune(state)
+
+    def _feed_block_attestations(self, signed_block, state) -> List:
+        """Apply a block's carried attestations to fork choice (and the
+        slasher) — shared by the import pipeline and the restart
+        recovery replay, so a replayed block has exactly the
+        fork-choice-visible effects of its original import."""
+        from .attestation_verification import attesting_indices
+        resolved = []
+        for att in signed_block.message.body.attestations:
+            try:
+                idx, _committee = attesting_indices(state, att, self.preset)
+                resolved.append((int(att.data.slot), idx.tolist()))
+                indexed = _Indexed(att.data, idx.tolist())
+                # Slasher BEFORE fork choice: an attestation naming an
+                # unknown head block (orphaned branch — the very shape a
+                # double vote takes) raises below, and must still be
+                # ingested for detection.
+                if self.slasher is not None:
+                    self.slasher.accept_attestation(indexed)
+                self.fork_choice.on_attestation(indexed,
+                                                is_from_block=True)
+            except Exception:
+                pass  # block attestations are best-effort for fork choice
+        return resolved
+
+    def _replay_imported_block(self, signed_block, block_root: bytes,
+                               state) -> None:
+        """Restart-recovery replay of one journaled import
+        (:func:`..store.recovery.reconcile`): re-run the fork-choice
+        effects of `_import_block` from the store's copy of the block
+        and its post-state."""
+        self.fork_choice.on_block(signed_block, block_root, state)
+        self._feed_block_attestations(signed_block, state)
 
     def _produce_light_client_updates(self, signed_block) -> None:
         """Produce + cache LC finality/optimistic updates when the block

@@ -177,13 +177,26 @@ def cmd_beacon_node(args) -> int:
     # boot from interop genesis.
     chain = None
     if args.datadir:
+        from .beacon_chain.errors import BlockError
+        from .store import StoreCorruption
         try:
             chain = BeaconChain.resume(store=store, preset=h.preset,
                                        spec=h.spec, T=h.T)
             print(f"resumed chain at slot {chain.head.slot} "
                   f"head={chain.head.root.hex()[:12]}")
-        except Exception:
-            chain = None
+            rec = chain.last_recovery
+            if rec is not None and (rec.quarantined or rec.replayed
+                                    or rec.rebuilt_fork_choice):
+                print(f"startup recovery: {rec.summary()}")
+        except StoreCorruption:
+            # Do NOT fall back to a fresh genesis chain here: the
+            # BeaconChain constructor persists (overwriting the
+            # fork-choice snapshot and clearing the journal), which
+            # would destroy exactly the bytes the operator needs to
+            # restore from.  Surface the actionable error instead.
+            raise
+        except BlockError:
+            chain = None  # virgin datadir: no persisted chain yet
     if chain is None:
         chain = BeaconChain(store=store, genesis_state=h.state.copy(),
                             genesis_block_root=hdr.tree_hash_root(),

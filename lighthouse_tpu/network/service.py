@@ -119,14 +119,30 @@ class NetworkNode:
             bus.subscribe(TOPIC_BLOB_SIDECAR.format(subnet),
                           self._blob_handler)
 
-    def close(self) -> None:
-        """Tear the node down: stop the processor and release the
-        chain's streaming-verification hooks — including this node's
-        refcount on the process-global BLS envelope, so a dead node's
-        breaker state cannot route later module-level verifies through
-        watchdogs/host fallback."""
+    def close(self, persist: bool = True) -> None:
+        """Tear the node down: persist the chain's fork-choice/op-pool
+        snapshot (a clean shutdown must not lose the votes accumulated
+        since the last finalization — `persist_fork_choice` on drop in
+        the reference), stop the processor and release the chain's
+        streaming-verification hooks — including this node's refcount on
+        the process-global BLS envelope, so a dead node's breaker state
+        cannot route later module-level verifies through watchdogs/host
+        fallback.  ``persist=False`` models a crash (the simulator's
+        SIGKILL stand-in): nothing beyond the already-committed atomic
+        batches reaches the store."""
         self.processor.stop()
+        # Drain in-flight verification first (release flushes), so votes
+        # registering from completion callbacks make the final snapshot.
         self.chain.release_verification_service()
+        if persist:
+            try:
+                self.chain.persist()
+            except Exception as e:
+                # Teardown must complete even over a store that is
+                # already closed/broken; the journal still bounds what a
+                # restart has to replay.
+                self.log.warn("persist-on-close failed",
+                              err=f"{type(e).__name__}: {e}")
 
     # -- publishing ----------------------------------------------------------
 

@@ -648,7 +648,9 @@ class WireNetwork:
             dial=self.connect_unique, interval=interval,
             log=self.node.log)
 
-    def close(self) -> None:
+    def close(self, persist: bool = True) -> None:
+        """``persist=False`` is the crash shape: sockets drop, nothing
+        is flushed to the store beyond already-committed batches."""
         self._hb_stop.set()
         try:
             self._listener.close()
@@ -656,7 +658,7 @@ class WireNetwork:
             pass
         for c in list(self._conns):
             c.close()
-        self.node.close()
+        self.node.close(persist=persist)
 
     def _on_close(self, conn: _Conn) -> None:
         with self._lock:

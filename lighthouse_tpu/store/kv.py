@@ -8,13 +8,22 @@
   (the reference's ``MemoryStore``);
 - :class:`SqliteStore` — embedded on-disk engine (the reference links
   LevelDB/C++; SQLite is the embedded native store available here), with
-  WAL journaling and batched atomic writes.
+  WAL journaling, batched atomic writes and a durability knob
+  (``PRAGMA synchronous`` via ``LIGHTHOUSE_TPU_STORE_SYNC``).
+
+The checksum frame (:func:`frame_value` / :func:`unframe_value`) lives
+here so the hot/cold DB, the schema migrations and the recovery scan all
+share one encoding: a torn or bit-rotted row must be *detected* at read
+time, never silently decoded into a wrong state.
 """
 
 from __future__ import annotations
 
+import os
 import sqlite3
+import struct
 import threading
+import zlib
 from enum import Enum
 from typing import Iterable, Optional, Sequence, Tuple
 
@@ -33,6 +42,43 @@ class DBColumn(str, Enum):
     ColdBlock = "cbk"
     ColdState = "cst"
     BlobSidecar = "blb"
+    # Crash-consistency additions (schema v2): the per-import journal
+    # whose entries bound the restart replay window, and the quarantine
+    # column recovery moves checksum-failing rows into (kept for
+    # post-mortem instead of deleted).
+    StoreJournal = "jnl"
+    Quarantine = "qtn"
+
+
+# -- checksum frame (schema v2) ----------------------------------------------
+
+CHECKSUM_MAGIC = 0xC5
+_FRAME_HDR = 5  # magic byte + crc32
+
+
+class ChecksumError(ValueError):
+    """A framed value failed its integrity check (torn write / bit rot)."""
+
+
+def frame_value(value: bytes) -> bytes:
+    """``magic ‖ crc32(value) ‖ value`` — the schema-v2 on-disk frame."""
+    value = bytes(value)
+    return (bytes([CHECKSUM_MAGIC])
+            + struct.pack("<I", zlib.crc32(value) & 0xFFFFFFFF) + value)
+
+
+def unframe_value(data: bytes) -> bytes:
+    """Verify and strip a frame; raises :class:`ChecksumError` on a bad
+    magic byte, short row, or CRC mismatch."""
+    if len(data) < _FRAME_HDR or data[0] != CHECKSUM_MAGIC:
+        raise ChecksumError("missing checksum frame")
+    (want,) = struct.unpack_from("<I", data, 1)
+    value = bytes(data[_FRAME_HDR:])
+    got = zlib.crc32(value) & 0xFFFFFFFF
+    if got != want:
+        raise ChecksumError(
+            f"checksum mismatch: stored {want:#010x} != computed {got:#010x}")
+    return value
 
 
 class KeyValueStore:
@@ -92,14 +138,35 @@ class MemoryStore(KeyValueStore):
         return iter(items)
 
 
-class SqliteStore(KeyValueStore):
-    """One table per database: (column, key) → value, WAL mode."""
+# PRAGMA synchronous levels accepted by the durability knob.  WAL +
+# NORMAL is the crash-safe default for *process* death (a committed
+# transaction is always intact — exactly the SIGKILL drill's model);
+# FULL/EXTRA additionally survive OS crash / power loss at an fsync-per-
+# commit cost; OFF trades all durability for speed (ephemeral harnesses).
+_SYNC_LEVELS = {"off": "OFF", "normal": "NORMAL", "full": "FULL",
+                "extra": "EXTRA"}
 
-    def __init__(self, path: str):
+
+class SqliteStore(KeyValueStore):
+    """One table per database: (column, key) → value, WAL mode.
+
+    ``sync`` (or env ``LIGHTHOUSE_TPU_STORE_SYNC``) selects the
+    ``PRAGMA synchronous`` level — see :data:`_SYNC_LEVELS`.
+    """
+
+    def __init__(self, path: str, sync: Optional[str] = None):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
+        sync = (sync or os.environ.get("LIGHTHOUSE_TPU_STORE_SYNC",
+                                       "normal")).lower()
+        if sync not in _SYNC_LEVELS:
+            raise ValueError(
+                f"LIGHTHOUSE_TPU_STORE_SYNC={sync!r}: expected one of "
+                f"{sorted(_SYNC_LEVELS)}")
+        self.sync = sync
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(f"PRAGMA synchronous={_SYNC_LEVELS[sync]}")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv ("
                 "col TEXT NOT NULL, key BLOB NOT NULL, value BLOB NOT NULL, "

@@ -1,0 +1,299 @@
+"""Kill-at-every-op crash drill for the persistence layer.
+
+The crash-consistency claim is behavioral: *whatever store op the
+process dies after, a restart recovers a chain identical to one that
+never crashed*.  This module proves it by construction — a
+fault-injecting KV wrapper (:class:`CrashingStore`, driven by the same
+seeded :class:`~.faults.FaultInjector` plans as the streaming-verify
+drills) kills the node at store op N, the drill restarts from the
+surviving bytes, finishes the import sequence, and diffs the result
+against a never-crashed oracle — for EVERY N.
+
+Shared by ``tests/test_store_recovery.py`` (randomized/quick),
+``scripts/validate_crash_recovery.py`` (exhaustive + SIGKILL subprocess
+mode) and the bench ``restart_recovery`` row.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from ..beacon_chain import BeaconChain
+from ..store import HotColdDB, KeyValueStore, MemoryStore, SqliteStore
+from .faults import FaultInjector, InjectedFault
+
+# Effectively-infinite outage end: once the kill fires, NOTHING later
+# lands (a dead process issues no more writes).
+_FOREVER = 1 << 62
+
+
+class CrashingStore(KeyValueStore):
+    """KV wrapper with a failure point in front of every MUTATION.
+
+    Reads pass through untouched (a dead process's reads are moot);
+    ``put``/``delete``/``do_atomically`` each count as ONE op at the
+    ``"store_op"`` site — a batch is atomic at the engine layer (SQLite
+    rolls an uncommitted transaction back; MemoryStore applies under
+    one lock), so "killed inside a batch" and "killed before it" are
+    the same store state.
+    """
+
+    SITE = "store_op"
+
+    def __init__(self, inner: KeyValueStore, injector: FaultInjector):
+        self.inner = inner
+        self.injector = injector
+
+    def get(self, column, key):
+        return self.inner.get(column, key)
+
+    def iter_column(self, column):
+        return self.inner.iter_column(column)
+
+    def put(self, column, key, value):
+        self.injector.check(self.SITE)
+        self.inner.put(column, key, value)
+
+    def delete(self, column, key):
+        self.injector.check(self.SITE)
+        self.inner.delete(column, key)
+
+    def do_atomically(self, ops):
+        self.injector.check(self.SITE)
+        self.inner.do_atomically(ops)
+
+    def close(self):
+        self.inner.close()
+
+    @property
+    def mutations(self) -> int:
+        return self.injector.calls.get(self.SITE, 0)
+
+
+# -- deterministic fixture ----------------------------------------------------
+
+
+@dataclass
+class ChainFixture:
+    """A pre-built deterministic block sequence every drill run (and the
+    oracle, and a SIGKILL'd subprocess's parent) can regenerate
+    bit-identically: the harness uses interop keys and no entropy."""
+    preset: object
+    spec: object
+    T: object
+    genesis_state: object
+    genesis_root: bytes
+    blocks: List[Tuple[int, bytes, object]]  # (slot, root, signed_block)
+
+
+def build_chain_fixture(slots: int = 32, n_validators: int = 16,
+                        preset=None) -> ChainFixture:
+    from ..types.presets import MINIMAL
+    from .harness import StateHarness
+
+    h = StateHarness(n_validators=n_validators, preset=preset or MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    genesis_root = hdr.tree_hash_root()
+    genesis_state = h.state.copy()
+    blocks = []
+    for _ in range(slots):
+        sb = h.build_block()
+        h.apply_block(sb)
+        blocks.append((int(sb.message.slot),
+                       sb.message.tree_hash_root(), sb))
+    return ChainFixture(preset=h.preset, spec=h.spec, T=h.T,
+                        genesis_state=genesis_state,
+                        genesis_root=genesis_root, blocks=blocks)
+
+
+def make_chain(store: HotColdDB, fixture: ChainFixture) -> BeaconChain:
+    return BeaconChain(store=store,
+                       genesis_state=fixture.genesis_state.copy(),
+                       genesis_block_root=fixture.genesis_root,
+                       preset=fixture.preset, spec=fixture.spec,
+                       T=fixture.T)
+
+
+def import_sequence(chain: BeaconChain, fixture: ChainFixture) -> None:
+    """Drive the fixture's blocks through the full import pipeline,
+    skipping roots fork choice already holds (the post-restart resume
+    path re-drives the same loop).  Ends on a final tick + head
+    recompute so queued votes drain identically on every run."""
+    for slot, root, sb in fixture.blocks:
+        chain.per_slot_task(slot)
+        if not chain.fork_choice.contains_block(root):
+            chain.process_block(sb)
+    chain.per_slot_task(fixture.blocks[-1][0] + 1)
+    chain.recompute_head()
+
+
+# -- backends -----------------------------------------------------------------
+
+
+class MemoryBackend:
+    """The MemoryStore object IS the disk: it survives the simulated
+    process death and the restart reads the same dict."""
+
+    name = "memory"
+
+    def fresh(self) -> KeyValueStore:
+        return MemoryStore()
+
+    def reopen(self, kv: KeyValueStore) -> KeyValueStore:
+        return kv
+
+
+class SqliteBackend:
+    """A fresh file per run; restart closes the crashed process's
+    connection and opens a new one against the same file."""
+
+    name = "sqlite"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._n = 0
+        self._paths: dict[int, str] = {}
+
+    def fresh(self) -> KeyValueStore:
+        self._n += 1
+        path = os.path.join(self.directory, f"drill-{self._n}.sqlite")
+        kv = SqliteStore(path)
+        self._paths[id(kv)] = path
+        return kv
+
+    def reopen(self, kv: KeyValueStore) -> KeyValueStore:
+        path = self._paths[id(kv)]
+        kv.close()
+        return SqliteStore(path)
+
+
+# -- comparison ---------------------------------------------------------------
+
+
+def chain_fingerprint(chain: BeaconChain) -> dict:
+    """Everything a restart must preserve: head, checkpoints, and the
+    full fork-choice weight surface."""
+    fc = chain.fork_choice
+    proto = fc.proto.to_host() if hasattr(fc.proto, "to_host") else fc.proto
+    return {
+        "head": chain.head.root.hex(),
+        "head_slot": chain.head.slot,
+        "justified": (fc.justified_checkpoint[0],
+                      fc.justified_checkpoint[1].hex()),
+        "finalized": (fc.finalized_checkpoint[0],
+                      fc.finalized_checkpoint[1].hex()),
+        "weights": {n.root.hex(): int(n.weight) for n in proto.nodes},
+    }
+
+
+def compare_chains(recovered: BeaconChain,
+                   oracle: BeaconChain) -> List[str]:
+    """Human-readable divergences (empty == identical)."""
+    a, b = chain_fingerprint(recovered), chain_fingerprint(oracle)
+    out = []
+    for field in ("head", "head_slot", "justified", "finalized"):
+        if a[field] != b[field]:
+            out.append(f"{field}: recovered={a[field]} oracle={b[field]}")
+    if a["weights"] != b["weights"]:
+        only_a = sorted(set(a["weights"]) - set(b["weights"]))
+        only_b = sorted(set(b["weights"]) - set(a["weights"]))
+        diff = sorted(r for r in set(a["weights"]) & set(b["weights"])
+                      if a["weights"][r] != b["weights"][r])
+        out.append(f"weights: extra={only_a[:3]} missing={only_b[:3]} "
+                   f"changed={[(r[:12], a['weights'][r], b['weights'][r]) for r in diff[:3]]}")
+    return out
+
+
+# -- drill --------------------------------------------------------------------
+
+
+def run_oracle(fixture: ChainFixture, backend) -> BeaconChain:
+    store = HotColdDB(backend.fresh(), fixture.preset, fixture.spec,
+                      fixture.T)
+    chain = make_chain(store, fixture)
+    import_sequence(chain, fixture)
+    return chain
+
+
+def count_store_ops(fixture: ChainFixture, backend) -> int:
+    """Total mutation count of a clean run — the drill's kill-point
+    universe (the chain-construction ops are excluded: the drill arms
+    the injector only once the node is up, matching a process that
+    completed its boot)."""
+    inj = FaultInjector(seed=0)
+    kv = CrashingStore(backend.fresh(), inj)
+    store = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain = make_chain(store, fixture)
+    before = kv.mutations
+    import_sequence(chain, fixture)
+    return kv.mutations - before
+
+
+def run_kill_point(fixture: ChainFixture, backend, kill_at: int,
+                   *, seed: int = 0) -> Tuple[BeaconChain, bool, object]:
+    """One drill run: import, die after store op ``kill_at`` (counted
+    from the armed point), restart, recover, finish the sequence.
+    Returns (recovered_chain, crashed?, recovery_report)."""
+    inj = FaultInjector(seed=seed)
+    inner = backend.fresh()
+    crashing = CrashingStore(inner, inj)
+    store = HotColdDB(crashing, fixture.preset, fixture.spec, fixture.T)
+    chain = make_chain(store, fixture)
+    # The injector's outage window is an ABSOLUTE per-site sequence
+    # range, and chain construction already consumed a few mutations
+    # (schema put, genesis state, anchor persist): arm relative to the
+    # current counter so kill point N means "the Nth op of the IMPORT
+    # sequence" — otherwise points 0..C-1 alias to one crash and the
+    # final C ops (the finalization persist tail) are never killed.
+    armed_at = crashing.mutations
+    inj.plan(CrashingStore.SITE, outage=(armed_at + kill_at, _FOREVER))
+    crashed = False
+    try:
+        import_sequence(chain, fixture)
+    except InjectedFault:
+        crashed = True
+    # "Restart": a brand-new process sees only the surviving bytes.
+    kv2 = backend.reopen(inner)
+    store2 = HotColdDB(kv2, fixture.preset, fixture.spec, fixture.T)
+    chain2 = BeaconChain.from_store(store=store2, preset=fixture.preset,
+                                    spec=fixture.spec, T=fixture.T)
+    import_sequence(chain2, fixture)
+    return chain2, crashed, chain2.last_recovery
+
+
+def kill_point_drill(fixture: ChainFixture, backend,
+                     kill_points: Optional[List[int]] = None,
+                     *, seed: int = 0,
+                     on_progress: Optional[Callable] = None) -> dict:
+    """The full drill: oracle once, then every requested kill point.
+    ``kill_points=None`` means EVERY op of a clean run (exhaustive).
+    Returns a report dict; ``report["failures"]`` empty == green."""
+    oracle = run_oracle(fixture, backend)
+    total_ops = count_store_ops(fixture, backend)
+    if kill_points is None:
+        kill_points = list(range(total_ops))
+    failures = []
+    crashes = 0
+    replayed_total = 0
+    for n in kill_points:
+        chain2, crashed, report = run_kill_point(fixture, backend, n,
+                                                 seed=seed)
+        crashes += int(crashed)
+        replayed_total += len(report.replayed) if report else 0
+        divergences = compare_chains(chain2, oracle)
+        if divergences:
+            failures.append({"kill_at": n, "divergences": divergences})
+        if on_progress is not None:
+            on_progress(n, len(kill_points), bool(divergences))
+    return {
+        "backend": backend.name,
+        "slots": len(fixture.blocks),
+        "total_ops": total_ops,
+        "kill_points": len(kill_points),
+        "crashes": crashes,
+        "replayed_total": replayed_total,
+        "failures": failures,
+    }

@@ -65,44 +65,103 @@ class Simulator:
     debugging."""
 
     def __init__(self, n_nodes: int = 3, n_validators: int = 16,
-                 preset=None, secure: bool = True):
+                 preset=None, secure: bool = True,
+                 datadir: Optional[str] = None):
+        """``datadir`` switches every node's store from in-memory to an
+        on-disk SQLite file under ``datadir/node{i}.sqlite`` — the shape
+        the crash/restart scenario needs (a SIGKILL'd node's datadir
+        survives; :meth:`crash_node` + :meth:`restart_node`)."""
+        import os
+
         from .harness import StateHarness
+        from ..store.kv import SqliteStore
         from ..types.presets import MINIMAL
 
         self.preset = preset or MINIMAL
+        self.secure = secure
+        self.datadir = datadir
         self.harness = StateHarness(n_validators=n_validators,
                                     preset=self.preset)
         h = self.harness
         hdr = h.state.latest_block_header.copy()
         hdr.state_root = h.state.tree_hash_root()
         genesis_root = hdr.tree_hash_root()
+        self.genesis_root = genesis_root
 
         self.boot = BootNode()
         self.nodes: List[SimNode] = []
+        self._down: dict[int, dict] = {}  # crashed nodes awaiting restart
         share = n_validators // n_nodes
+        self._node_cfg: List[dict] = []
         for i in range(n_nodes):
+            lo = i * share
+            hi = n_validators if i == n_nodes - 1 else lo + share
+            path = (os.path.join(datadir, f"node{i}.sqlite")
+                    if datadir else None)
+            self._node_cfg.append({"lo": lo, "hi": hi, "path": path})
+            kv = SqliteStore(path) if path else None
             chain = BeaconChain(
-                store=HotColdDB.memory(h.preset, h.spec, h.T),
+                store=(HotColdDB(kv, h.preset, h.spec, h.T) if kv
+                       else HotColdDB.memory(h.preset, h.spec, h.T)),
                 genesis_state=h.state.copy(),
                 genesis_block_root=genesis_root,
                 preset=h.preset, spec=h.spec, T=h.T)
-            net = WireNetwork(chain, name=f"node{i}", secure=secure)
-            disco = net.discover("127.0.0.1", self.boot.port, interval=0.2)
-            lo = i * share
-            hi = n_validators if i == n_nodes - 1 else lo + share
-            vstore = ValidatorStore()
-            for v in range(lo, hi):
-                vstore.add_validator(interop_secret_key(v), index=v)
-            vc = ValidatorClient(vstore, [_GossipingBeaconNode(net)],
-                                 h.preset)
-            self.nodes.append(SimNode(net=net, vc=vc, discovery=disco))
+            self.nodes.append(self._start_node(i, chain))
+
+    def _start_node(self, i: int, chain: BeaconChain) -> SimNode:
+        h = self.harness
+        cfg = self._node_cfg[i]
+        net = WireNetwork(chain, name=f"node{i}", secure=self.secure)
+        disco = net.discover("127.0.0.1", self.boot.port, interval=0.2)
+        vstore = ValidatorStore()
+        for v in range(cfg["lo"], cfg["hi"]):
+            vstore.add_validator(interop_secret_key(v), index=v)
+        vc = ValidatorClient(vstore, [_GossipingBeaconNode(net)], h.preset)
+        return SimNode(net=net, vc=vc, discovery=disco)
+
+    # -- crash / restart -----------------------------------------------------
+
+    def crash_node(self, i: int) -> None:
+        """SIGKILL stand-in: the node's sockets drop and its process
+        state evaporates — ``persist=False`` means NOTHING beyond the
+        already-committed atomic import batches reaches the store.  The
+        datadir (SQLite file) survives for :meth:`restart_node`."""
+        node = self.nodes[i]
+        node.discovery.close()
+        node.net.close(persist=False)
+        node.chain.store.kv.close()
+        self._down[i] = {"cfg": self._node_cfg[i]}
+        self.nodes[i] = None  # type: ignore[assignment]
+
+    def restart_node(self, i: int) -> SimNode:
+        """Boot a fresh node from the crashed node's datadir: resume +
+        startup recovery rebuild the chain at exactly the last committed
+        import; range sync then catches it up to its peers."""
+        from ..store.kv import SqliteStore
+
+        assert i in self._down, "node was not crashed"
+        cfg = self._down.pop(i)["cfg"]
+        assert cfg["path"], "restart requires an on-disk datadir"
+        h = self.harness
+        kv = SqliteStore(cfg["path"])
+        store = HotColdDB(kv, h.preset, h.spec, h.T)
+        chain = BeaconChain.from_store(store=store, preset=h.preset,
+                                       spec=h.spec, T=h.T)
+        node = self._start_node(i, chain)
+        self.nodes[i] = node
+        return node
+
+    @property
+    def live_nodes(self) -> List[SimNode]:
+        return [n for n in self.nodes if n is not None]
 
     def wait_for_mesh(self, timeout: float = 20.0) -> bool:
-        """Every node discovers every other node."""
-        want = len(self.nodes) - 1
+        """Every live node discovers every other live node."""
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if all(len(n.net.node.peers) >= want for n in self.nodes):
+            live = self.live_nodes
+            want = len(live) - 1
+            if all(len(n.net.node.peers) >= want for n in live):
                 return True
             time.sleep(0.05)
         return False
@@ -110,23 +169,23 @@ class Simulator:
     def run_slot(self, slot: int) -> None:
         """One slot: tick every chain, drive every VC, drain queues,
         then fire the 3/4-slot state-advance timer for the next slot."""
-        for n in self.nodes:
+        for n in self.live_nodes:
             n.chain.per_slot_task(slot)
-        for n in self.nodes:
+        for n in self.live_nodes:
             n.vc.on_slot(slot)
         # Let gossip propagate and queues drain (bounded settle loop).
         for _ in range(40):
             busy = False
-            for n in self.nodes:
+            for n in self.live_nodes:
                 if n.net.node.processor.run_until_idle():
                     busy = True
             if not busy:
                 time.sleep(0.02)
                 drained = all(not n.net.node.processor.run_until_idle()
-                              for n in self.nodes)
+                              for n in self.live_nodes)
                 if drained:
                     break
-        for n in self.nodes:  # `state_advance_timer.rs` 3/4-slot hook
+        for n in self.live_nodes:  # `state_advance_timer.rs` 3/4-slot hook
             n.chain.on_three_quarters_slot(slot)
 
     def run(self, n_slots: int) -> None:
@@ -136,14 +195,14 @@ class Simulator:
     # -- assertions ----------------------------------------------------------
 
     def heads(self) -> set:
-        return {n.chain.head.root for n in self.nodes}
+        return {n.chain.head.root for n in self.live_nodes}
 
     def finalized_epochs(self) -> List[int]:
         return [n.chain.fork_choice.finalized_checkpoint[0]
-                for n in self.nodes]
+                for n in self.live_nodes]
 
     def close(self) -> None:
-        for n in self.nodes:
+        for n in self.live_nodes:
             n.discovery.close()
             n.net.close()
         self.boot.close()

@@ -1,0 +1,438 @@
+"""Crash-safe store & restart recovery.
+
+Kill-point differentials (every injected kill point N → restart → state
+identical to a never-crashed oracle), checksum-corruption quarantine,
+v1→v2 schema migration on a store written by the current code, and
+MemoryStore+SqliteStore parity.  All host logic — quick tier, fake BLS.
+"""
+
+import os
+import random
+import struct
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.network.service import GossipBus, NetworkNode
+from lighthouse_tpu.store import (
+    DBColumn,
+    HotColdDB,
+    SCHEMA_VERSION,
+    SqliteStore,
+    StoreCorruption,
+    StoreError,
+    unframe_value,
+)
+from lighthouse_tpu.store.migrations import FRAMED_COLUMNS
+from lighthouse_tpu.testing.crash_drill import (
+    MemoryBackend,
+    SqliteBackend,
+    build_chain_fixture,
+    compare_chains,
+    count_store_ops,
+    import_sequence,
+    kill_point_drill,
+    make_chain,
+    run_kill_point,
+    run_oracle,
+)
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    B.set_backend("fake")
+    try:
+        return build_chain_fixture(slots=32)
+    finally:
+        B.set_backend("python")
+
+
+def _flip_last_byte(kv, column, key):
+    data = kv.get(column, key)
+    assert data is not None
+    kv.put(column, key, data[:-1] + bytes([data[-1] ^ 0xFF]))
+
+
+def _fresh_chain(fixture, backend=None):
+    backend = backend or MemoryBackend()
+    kv = backend.fresh()
+    store = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    return kv, store, make_chain(store, fixture)
+
+
+# -- kill-point differentials -------------------------------------------------
+
+
+def test_kill_point_differential_memory(fixture):
+    """Randomized kill points + the full finalization tail (the import/
+    migrate/persist boundary ops): every one must recover to the
+    oracle's exact head/checkpoints/weights.  The EXHAUSTIVE sweep runs
+    in scripts/validate_crash_recovery.py."""
+    total = count_store_ops(fixture, MemoryBackend())
+    assert total > len(fixture.blocks)  # migrate + persist ops present
+    rng = random.Random(7)
+    points = sorted(set(rng.sample(range(total - 5), 8))
+                    | set(range(total - 5, total)))
+    rep = kill_point_drill(fixture, MemoryBackend(), points, seed=7)
+    assert rep["failures"] == []
+    assert rep["crashes"] == len(points)
+
+
+def test_kill_point_differential_sqlite(fixture, tmp_path):
+    total = count_store_ops(fixture, MemoryBackend())
+    rng = random.Random(11)
+    points = sorted(rng.sample(range(total), 4)) + [total - 1]
+    rep = kill_point_drill(fixture, SqliteBackend(str(tmp_path)), points,
+                           seed=11)
+    assert rep["failures"] == []
+
+
+def test_memory_sqlite_kill_point_parity(fixture, tmp_path):
+    """Same kill point on both backends → identical recovered chains."""
+    kill_at = len(fixture.blocks) // 2
+    mem_chain, crashed_m, _ = run_kill_point(fixture, MemoryBackend(),
+                                             kill_at)
+    sql_chain, crashed_s, _ = run_kill_point(
+        fixture, SqliteBackend(str(tmp_path)), kill_at)
+    assert crashed_m and crashed_s
+    assert compare_chains(mem_chain, sql_chain) == []
+
+
+def test_clean_restart_equals_oracle(fixture):
+    """kill_at beyond the op universe = no crash at all; the resume
+    path must still reproduce the oracle exactly."""
+    chain2, crashed, report = run_kill_point(fixture, MemoryBackend(),
+                                             10_000)
+    assert not crashed
+    oracle = run_oracle(fixture, MemoryBackend())
+    assert compare_chains(chain2, oracle) == []
+    # Persist-on-finalization bounded the window: replay covers only the
+    # imports after the last finalization snapshot, not the whole chain.
+    assert report is not None
+    assert len(report.replayed) < len(fixture.blocks)
+
+
+# -- corruption detection & quarantine ---------------------------------------
+
+
+def test_checksum_corruption_quarantined_and_reimported(fixture):
+    """A torn/bit-rotted row in the post-snapshot window: quarantined on
+    restart, the partial import de-orphaned, and the block re-imports
+    cleanly afterwards."""
+    kv, store, chain = _fresh_chain(fixture)
+    # Import a pre-finalization prefix: every block is still inside the
+    # journal replay window (no finalization persist has covered it).
+    short = fixture.blocks[:28]
+    for slot, root, sb in short:
+        chain.per_slot_task(slot)
+        chain.process_block(sb)
+    assert chain.fork_choice.finalized_checkpoint[0] == 0
+    last_root = short[-1][1]
+    _flip_last_byte(kv, DBColumn.BeaconBlock, last_root)
+
+    store2 = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain2 = BeaconChain.from_store(store=store2, preset=fixture.preset,
+                                    spec=fixture.spec, T=fixture.T)
+    report = chain2.last_recovery
+    assert [q.column for q in report.quarantined] == [DBColumn.BeaconBlock]
+    assert last_root in report.orphans_removed
+    assert not chain2.fork_choice.contains_block(last_root)
+    # The quarantined original is preserved for post-mortem.
+    qkey = DBColumn.BeaconBlock.value.encode() + b":" + last_root
+    assert kv.get(DBColumn.Quarantine, qkey) is not None
+    # Re-import of the de-orphaned block restores oracle equality.
+    import_sequence(chain2, fixture)
+    assert compare_chains(chain2, run_oracle(fixture, MemoryBackend())) == []
+
+
+def test_corrupt_snapshot_block_raises_actionable(fixture):
+    """A corrupt row the persisted fork-choice snapshot depends on is
+    unrecoverable: resume must refuse with StoreCorruption, not decode
+    garbage or silently drop chain history."""
+    kv, store, chain = _fresh_chain(fixture)
+    import_sequence(chain, fixture)
+    chain.persist()  # snapshot now covers every imported block
+    head_root = chain.head.root
+    _flip_last_byte(kv, DBColumn.BeaconBlock, head_root)
+    store2 = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    with pytest.raises(StoreCorruption) as ei:
+        BeaconChain.from_store(store=store2, preset=fixture.preset,
+                               spec=fixture.spec, T=fixture.T)
+    assert "resync" in str(ei.value) or "restore" in str(ei.value)
+
+
+def test_hot_path_read_of_corrupt_row_raises(fixture):
+    """Outside recovery, a checksum-failing row surfaces as
+    StoreCorruption at read time — never a silently wrong decode."""
+    kv, store, chain = _fresh_chain(fixture)
+    import_sequence(chain, fixture)
+    root = fixture.blocks[-1][1]
+    _flip_last_byte(kv, DBColumn.BeaconBlock, root)
+    with pytest.raises(StoreCorruption):
+        store.get_block(root)
+
+
+def test_corrupt_head_state_raises_store_corruption(fixture):
+    """A bit-rotted HEAD STATE row (quarantined in stage 1, so the head
+    block still resolves but its post-state is gone) must surface as
+    StoreCorruption — NOT the virgin-datadir BlockError, which cli.py
+    maps to a destructive fresh-chain fallback (review finding)."""
+    kv, store, chain = _fresh_chain(fixture)
+    import_sequence(chain, fixture)
+    chain.persist()
+    head_block = store.get_block(chain.head.root)
+    state_root = bytes(head_block.message.state_root)
+    # The head state may be full or a summary row; corrupt whichever.
+    col = (DBColumn.BeaconState
+           if kv.get(DBColumn.BeaconState, state_root) is not None
+           else DBColumn.BeaconStateSummary)
+    _flip_last_byte(kv, col, state_root)
+    store2 = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    with pytest.raises(StoreCorruption):
+        BeaconChain.from_store(store=store2, preset=fixture.preset,
+                               spec=fixture.spec, T=fixture.T)
+
+
+def test_corrupt_fork_choice_blob_rebuilds_by_replay(fixture):
+    """The snapshot itself is damaged: recovery falls back to a full
+    rebuild — fresh genesis fork choice + every stored block replayed —
+    and lands on the oracle head."""
+    kv, store, chain = _fresh_chain(fixture)
+    import_sequence(chain, fixture)
+    chain.persist()
+    _flip_last_byte(kv, DBColumn.ForkChoice, b"fork_choice")
+    store2 = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain2 = BeaconChain.from_store(store=store2, preset=fixture.preset,
+                                    spec=fixture.spec, T=fixture.T)
+    assert chain2.last_recovery.rebuilt_fork_choice
+    oracle = run_oracle(fixture, MemoryBackend())
+    assert chain2.head.root == oracle.head.root
+    assert chain2.fork_choice.finalized_checkpoint == \
+        oracle.fork_choice.finalized_checkpoint
+    # And the rebuilt chain keeps importing.
+    import_sequence(chain2, fixture)
+    assert compare_chains(chain2, oracle) == []
+
+
+# -- schema migrations --------------------------------------------------------
+
+
+def _downgrade_to_v1(kv):
+    """Rewrite a v2 store in the v1 layout: raw (unframed) values, no
+    journal column, schema=1 — byte-identical to what the pre-migration
+    code wrote."""
+    ops = []
+    for col in FRAMED_COLUMNS:
+        for key, data in list(kv.iter_column(col)):
+            if col is DBColumn.StoreJournal:
+                ops.append(("delete", col, bytes(key), None))
+            else:
+                ops.append(("put", col, bytes(key), unframe_value(data)))
+    ops.append(("put", DBColumn.BeaconMeta, b"schema",
+                struct.pack("<Q", 1)))
+    kv.do_atomically(ops)
+
+
+def test_v1_store_migrates_transparently(fixture, tmp_path):
+    path = os.path.join(str(tmp_path), "v1.sqlite")
+    kv = SqliteStore(path)
+    store = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain = make_chain(store, fixture)
+    import_sequence(chain, fixture)
+    chain.persist()
+    roots = [(r, bytes(sb.message.state_root))
+             for _, r, sb in fixture.blocks]
+    _downgrade_to_v1(kv)
+    assert struct.unpack(
+        "<Q", kv.get(DBColumn.BeaconMeta, b"schema"))[0] == 1
+    kv.close()
+
+    kv2 = SqliteStore(path)
+    store2 = HotColdDB(kv2, fixture.preset, fixture.spec, fixture.T)
+    assert store2.schema_migrated_from == 1
+    assert struct.unpack(
+        "<Q", kv2.get(DBColumn.BeaconMeta, b"schema"))[0] == SCHEMA_VERSION
+    # Every block and state written at v1 loads under v2 (framed),
+    # including summary-replay states.
+    for block_root, state_root in roots:
+        assert store2.get_block(block_root) is not None
+        st = store2.get_state(state_root)
+        assert st is not None and st.tree_hash_root() == state_root
+    # The migrated store resumes into a working chain.
+    chain2 = BeaconChain.from_store(store=store2, preset=fixture.preset,
+                                    spec=fixture.spec, T=fixture.T)
+    assert chain2.head.root == chain.head.root
+    kv2.close()
+
+
+def test_interrupted_migration_resumes(fixture, monkeypatch):
+    """A crash mid-migration (process dies between batches) leaves the
+    version unchanged; reopening re-runs the step idempotently and
+    completes it."""
+    from lighthouse_tpu.store import MemoryStore, migrate_schema
+    from lighthouse_tpu.store import migrations as mig
+
+    kv = MemoryStore()
+    store = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain = make_chain(store, fixture)
+    for slot, root, sb in fixture.blocks[:10]:
+        chain.per_slot_task(slot)
+        chain.process_block(sb)
+    chain.persist()
+    _downgrade_to_v1(kv)
+    monkeypatch.setattr(mig, "MIGRATION_BATCH_ROWS", 4)
+
+    class Dying:
+        """Fails the 3rd commit — the migration dies between batches."""
+        def __init__(self, inner):
+            self.inner, self.commits = inner, 0
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def do_atomically(self, ops):
+            self.commits += 1
+            if self.commits == 3:
+                raise RuntimeError("simulated crash mid-migration")
+            self.inner.do_atomically(ops)
+
+    with pytest.raises(RuntimeError):
+        migrate_schema(Dying(kv), 1)
+    assert struct.unpack(
+        "<Q", kv.get(DBColumn.BeaconMeta, b"schema"))[0] == 1
+    # "Restart": plain reopen finishes the step (already-framed rows
+    # from the interrupted attempt are skipped, the rest framed).
+    store2 = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    assert struct.unpack(
+        "<Q", kv.get(DBColumn.BeaconMeta, b"schema"))[0] == SCHEMA_VERSION
+    for slot, root, sb in fixture.blocks[:10]:
+        assert store2.get_block(root) is not None
+
+
+def test_future_schema_refused(tmp_path):
+    path = os.path.join(str(tmp_path), "future.sqlite")
+    kv = SqliteStore(path)
+    kv.put(DBColumn.BeaconMeta, b"schema", struct.pack("<Q", 99))
+    kv.close()
+    from lighthouse_tpu.types.presets import MINIMAL
+    fx_kv = SqliteStore(path)
+    with pytest.raises(StoreError):
+        HotColdDB(fx_kv, MINIMAL, None, None)
+    fx_kv.close()
+
+
+# -- durability knob ----------------------------------------------------------
+
+
+def test_sqlite_sync_knob(tmp_path, monkeypatch):
+    levels = {"off": 0, "normal": 1, "full": 2, "extra": 3}
+    for name, want in levels.items():
+        monkeypatch.setenv("LIGHTHOUSE_TPU_STORE_SYNC", name)
+        kv = SqliteStore(os.path.join(str(tmp_path), f"{name}.sqlite"))
+        got = kv._conn.execute("PRAGMA synchronous").fetchone()[0]
+        assert got == want, name
+        assert kv.sync == name
+        kv.close()
+    monkeypatch.setenv("LIGHTHOUSE_TPU_STORE_SYNC", "bogus")
+    with pytest.raises(ValueError):
+        SqliteStore(os.path.join(str(tmp_path), "bogus.sqlite"))
+
+
+# -- persistence wiring -------------------------------------------------------
+
+
+def test_persist_fires_on_finalization_and_clears_journal(fixture):
+    """Fork-choice persistence is no longer shutdown-only: the journal
+    (replay window) resets at every finalization advance."""
+    kv, store, chain = _fresh_chain(fixture)
+    seen_empty_after_fin = False
+    for slot, root, sb in fixture.blocks:
+        chain.per_slot_task(slot)
+        chain.process_block(sb)
+        if chain.fork_choice.finalized_checkpoint[0] > 0:
+            entries = store.journal_entries()
+            # Entries only since the finalization persist, not the
+            # whole chain.
+            assert len(entries) < slot
+            if not entries:
+                seen_empty_after_fin = True
+    assert chain.fork_choice.finalized_checkpoint[0] >= 2
+    assert seen_empty_after_fin
+
+
+def test_network_node_close_persists_votes(fixture):
+    """A clean shutdown that never saw a finalization must not lose the
+    fork-choice snapshot: NetworkNode.close() persists; persist=False
+    (the crash shape) leaves only the journal."""
+    kv, store, chain = _fresh_chain(fixture)
+    node = NetworkNode(chain, GossipBus(), name="t")
+    short = fixture.blocks[:6]  # pre-finalization window
+    for slot, root, sb in short:
+        chain.per_slot_task(slot)
+        chain.process_block(sb)
+    assert len(store.journal_entries()) == len(short)
+    node.close()  # clean shutdown → persist + journal clear
+    assert store.journal_entries() == []
+    chain2 = BeaconChain.from_store(store=HotColdDB(
+        kv, fixture.preset, fixture.spec, fixture.T),
+        preset=fixture.preset, spec=fixture.spec, T=fixture.T)
+    assert chain2.head.root == chain.head.root
+    assert chain2.last_recovery.replayed == []
+
+
+def test_backfilled_history_survives_restart(fixture):
+    """Checkpoint-sync backfill stores blocks BELOW the anchor whose
+    parents are deliberately outside fork choice and which carry no
+    journal entries — recovery must not mistake them for orphaned
+    partial imports (review finding: they were quarantined wholesale)."""
+    oracle = run_oracle(fixture, MemoryBackend())
+    k = 20
+    slot_k, root_k, sb_k = fixture.blocks[k]
+    anchor_state = oracle.store.get_state(bytes(sb_k.message.state_root))
+    assert anchor_state is not None
+    kv = MemoryBackend().fresh()
+    store = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain = BeaconChain.from_checkpoint(
+        store=store, anchor_state=anchor_state, anchor_block=sb_k,
+        preset=fixture.preset, spec=fixture.spec, T=fixture.T)
+    # Backfill below the anchor (network/backfill.py shape: raw
+    # put_block, no journal, parents unknown to fork choice).
+    for slot, root, sb in fixture.blocks[:k]:
+        store.put_block(root, sb)
+    # And make a little forward progress past the anchor (staying
+    # inside the anchor's epoch: with a mid-epoch anchor, crossing the
+    # boundary justifies a pre-anchor root — a checkpoint-sync anchor
+    # choice concern, not a recovery one).
+    for slot, root, sb in fixture.blocks[k + 1:k + 3]:
+        chain.per_slot_task(slot)
+        chain.process_block(sb)
+    head = chain.head.root
+    # Crash-restart: no shutdown persist.
+    store2 = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain2 = BeaconChain.from_store(store=store2, preset=fixture.preset,
+                                    spec=fixture.spec, T=fixture.T)
+    report = chain2.last_recovery
+    assert report.orphans_removed == [] and report.quarantined == []
+    assert chain2.head.root == head
+    for slot, root, sb in fixture.blocks[:k]:  # backfill intact
+        assert store2.get_block(root) is not None
+
+
+def test_metrics_counters_emitted(fixture):
+    from lighthouse_tpu.common.metrics import REGISTRY
+    persists = REGISTRY.counter("store_persist_total")
+    replays = REGISTRY.counter("store_recovery_replayed_blocks")
+    p0, r0 = persists.value, replays.value
+    chain2, crashed, report = run_kill_point(
+        fixture, MemoryBackend(), len(fixture.blocks) - 2)
+    assert crashed
+    assert persists.value > p0
+    assert replays.value >= r0 + len(report.replayed) > r0
