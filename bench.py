@@ -127,6 +127,7 @@ def _emit(row: dict) -> None:
 
 def _bls_bench() -> dict:
     from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.common import tracing
     from lighthouse_tpu.crypto import tpu_backend as TB  # noqa (registers)
     from lighthouse_tpu.crypto.fields import R
 
@@ -168,7 +169,7 @@ def _bls_bench() -> dict:
     best = min(ts)
     # Snapshot the staged-pipeline decomposition of the headline batch
     # NOW — the single-set / fast-aggregate rows below overwrite it.
-    pipeline_stats = dict(TB.LAST_PIPELINE_STATS)
+    pipeline_stats = tracing.stage_split("pipeline")
 
     # Latency tier: one single-key set (gossip proposer-signature shape).
     single = [bls.SignatureSet(sks[0].sign(msgs[0]), [pks[0]], msgs[0])]
@@ -350,10 +351,10 @@ def _device_resident_state_root_bench() -> dict:
     overlapped.  Reports the materialize-once split, a zero-dirty warm
     root (bytes pushed ≈ 0), and a 0.1% / 1% / 10% dirty-fraction sweep
     with bytes-pushed-per-root."""
+    from lighthouse_tpu.common import tracing
     from lighthouse_tpu.ops.device_tree import (residency_snapshot,
                                                 reset_residency_stats)
-    from lighthouse_tpu.types.device_state import (LAST_MATERIALIZE_STATS,
-                                                   materialize_state)
+    from lighthouse_tpu.types.device_state import materialize_state
     from lighthouse_tpu.types.presets import MAINNET
     from lighthouse_tpu.types.factory import spec_types
     from lighthouse_tpu.types.chain_spec import ForkName
@@ -377,11 +378,10 @@ def _device_resident_state_root_bench() -> dict:
 
     reset_residency_stats()
     materialize_state(state)  # the ONE full-width push of this lineage
+    mat = tracing.stage_split("materialize")
     out = {
-        "state_root_device_materialize_ms":
-            LAST_MATERIALIZE_STATS.get("materialize_ms"),
-        "state_root_device_materialize_bytes":
-            LAST_MATERIALIZE_STATS.get("bytes_pushed"),
+        "state_root_device_materialize_ms": mat.get("materialize_ms"),
+        "state_root_device_materialize_bytes": mat.get("bytes_pushed"),
     }
 
     def timed_root() -> tuple:
@@ -865,6 +865,7 @@ def _kzg_bench() -> dict:
     setup's.
     """
     import random
+    from lighthouse_tpu.common import tracing
     from lighthouse_tpu.kzg import device as D, kzg as K
     from lighthouse_tpu.kzg.fr import BLS_MODULUS
     from lighthouse_tpu.kzg.trusted_setup import verification_setup
@@ -911,7 +912,7 @@ def _kzg_bench() -> dict:
             raise RuntimeError("valid batch rejected in timing loop")
         ts.append((time.perf_counter() - t0) * 1e3)
     best = min(ts)
-    stages = dict(D.LAST_KZG_TIMINGS)
+    stages = tracing.stage_split("kzg")
     return {
         "kzg_batch_verify_ms": round(best, 1),
         "kzg_batch_cold_ms": round(cold_ms, 1),

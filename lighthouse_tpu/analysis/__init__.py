@@ -1,0 +1,22 @@
+"""graftlint — repo-native static analysis.
+
+The AST lint pass that encodes the invariants this repo kept
+re-learning in review: knob reads through the typed registry, lock
+discipline on annotated state, JAX x64/shard_map/import-time hygiene,
+framed-column store writes, and the one-stage-data-surface rule.  See
+:mod:`.core` for the framework and ``scripts/lint.py`` for the CLI.
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_PATH,
+    BaselineError,
+    CHECKERS,
+    Checker,
+    Context,
+    Finding,
+    apply_baseline,
+    lint_files,
+    load_baseline,
+    run,
+    write_baseline,
+)

@@ -1,0 +1,136 @@
+"""jax-hygiene — the three JAX idioms this repo has re-learned in review.
+
+1. **x64 is scoped, never global.**  ``jax.config.update("jax_enable_
+   x64", ...)`` flips dtype semantics for EVERY jitted program in the
+   process — the crypto kernels are traced under 32-bit semantics and
+   silently produce wrong limbs afterwards.  The proven spelling is
+   the scoped context manager ``with jax.experimental.enable_x64():``
+   (see ``fork_choice/device_proto_array.py`` throughout).
+
+2. **One shard_map spelling.**  This container's jax (0.4.37) only has
+   ``jax.experimental.shard_map.shard_map`` with ``check_rep`` — the
+   top-level ``jax.shard_map`` and the ``check_vma`` kwarg exist only
+   in newer jax.  The proven portable spelling is the experimental
+   import + an explicit ``check_rep=False`` (``parallel/bls_shard.py``
+   ``sharded_g1_sum``, validated on single-chip AND the multichip
+   dryrun).
+
+3. **No ``jnp.`` computation at import time.**  A module-level
+   ``jnp.arange(...)`` materializes a device buffer (and may initialize
+   the backend) the moment the module imports — import order starts
+   deciding device state, and CPU-only test processes pay for buffers
+   they never use.  Module constants stay numpy; convert at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Checker, Context, Finding, dotted, register, str_const
+
+
+def _root(chain: str) -> str:
+    return chain.split(".", 1)[0]
+
+
+@register
+class JaxHygieneChecker(Checker):
+    name = "jax-hygiene"
+    doc = ("enable_x64 only as a scoped context manager; shard_map "
+           "only via jax.experimental.shard_map with check_rep=False; "
+           "no jnp. computation at module import time")
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        out: List[Finding] = []
+        self._scan(tree, path, out, depth=0, func="module")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module in ("jax", "jax.sharding") and \
+                    any(a.name == "shard_map" for a in node.names):
+                out.append(Finding(
+                    self.name, path, node.lineno,
+                    f"shard_map imported from {node.module!r} — only "
+                    f"jax.experimental.shard_map exists across the "
+                    f"jax versions this repo runs on",
+                    hint="from jax.experimental.shard_map import "
+                         "shard_map",
+                    detail="shard-map-import"))
+        return out
+
+    def _scan(self, node: ast.AST, path: str, out: List[Finding],
+              depth: int, func: str) -> None:
+        """depth counts enclosing function bodies (0 = import time)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                self._scan(d, path, out, depth, func)
+            for default in node.args.defaults + \
+                    [d for d in node.args.kw_defaults if d is not None]:
+                self._scan(default, path, out, depth, func)
+            for child in node.body:
+                self._scan(child, path, out, depth + 1, node.name)
+            return
+        if isinstance(node, ast.Lambda):
+            self._scan(node.body, path, out, depth + 1, func)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, path, out, depth, func)
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, path, out, depth, func)
+
+    def _call(self, node: ast.Call, path: str, out: List[Finding],
+              depth: int, func: str) -> None:
+        chain = dotted(node.func) or ""
+
+        if chain.endswith("config.update") and node.args:
+            key = str_const(node.args[0]) or ""
+            if "enable_x64" in key:
+                out.append(Finding(
+                    self.name, path, node.lineno,
+                    "global jax_enable_x64 via config.update — flips "
+                    "dtype semantics for every jitted program in the "
+                    "process (the crypto kernels trace under 32-bit "
+                    "semantics)",
+                    hint="use the scoped form: "
+                         "'with jax.experimental.enable_x64():'",
+                    detail=f"enable-x64-config:{func}"))
+
+        if chain == "jax.shard_map" or \
+                (chain.endswith(".shard_map")
+                 and _root(chain) == "jax"
+                 and "experimental" not in chain):
+            out.append(Finding(
+                self.name, path, node.lineno,
+                f"{chain}(...) — the top-level shard_map only exists "
+                f"in newer jax",
+                hint="from jax.experimental.shard_map import "
+                     "shard_map",
+                detail=f"shard-map-spelling:{func}"))
+        elif chain == "shard_map" or chain.endswith(".shard_map"):
+            # elif: a wrong-spelling call is ONE defect — reporting
+            # the missing check_rep too would mint a second waiver key
+            # that goes stale the moment the import is fixed.
+            kw = {k.arg: k.value for k in node.keywords}
+            ok = isinstance(kw.get("check_rep"), ast.Constant) and \
+                kw["check_rep"].value is False
+            if not ok:
+                out.append(Finding(
+                    self.name, path, node.lineno,
+                    "shard_map call without check_rep=False — the one "
+                    "spelling proven on this container's jax 0.4.37 "
+                    "AND the multichip dryrun (check_vma / implicit "
+                    "rep-checking are version-specific)",
+                    hint="pass check_rep=False explicitly (mirror "
+                         "parallel/bls_shard.sharded_g1_sum)",
+                    detail=f"shard-map-check-rep:{func}"))
+
+        if depth == 0 and (_root(chain) == "jnp"
+                           or chain.startswith("jax.numpy.")):
+            out.append(Finding(
+                self.name, path, node.lineno,
+                f"{chain}(...) at module import time — materializes "
+                f"device buffers / initializes the backend on import",
+                hint="keep module constants numpy and convert at "
+                     "trace time, or build lazily inside the function",
+                detail=f"module-jnp:{chain}"))

@@ -1,0 +1,115 @@
+"""knob-registry — every knob read goes through ``common/knobs.py``.
+
+Two invariants:
+
+1. **No raw env reads.**  Inside ``lighthouse_tpu/`` ANY
+   ``os.environ`` / ``os.getenv`` read is a finding (the package had
+   four truthiness dialects across ~23 knobs before the registry; the
+   ``LIGHTHOUSE_TPU_NO_NATIVE=0``-disables-native bug is what bare
+   truthiness buys).  In ``scripts/`` and ``bench.py`` only reads of
+   literal ``LIGHTHOUSE_TPU_*`` names are findings — those trees own
+   legitimate non-knob env vars (``BENCH_*``, ``XLA_FLAGS``).
+   Env *writes* (``os.environ[k] = v``, ``.pop``, ``del``) stay legal
+   everywhere: the validation scripts flip knobs on purpose.
+
+2. **No undeclared knob names.**  Every literal ``LIGHTHOUSE_TPU_*``
+   string anywhere in the lint set must be declared in
+   :data:`lighthouse_tpu.common.knobs.KNOBS` — a typo'd knob is a lint
+   failure, not a silently-ignored setting.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from ..core import Checker, Context, Finding, dotted, register, str_const
+
+KNOB_NAME_RE = re.compile(r"LIGHTHOUSE_TPU_[A-Z0-9][A-Z0-9_]*[A-Z0-9]")
+
+# The one module allowed to touch os.environ for knobs.
+ACCESSOR_MODULE = "lighthouse_tpu/common/knobs.py"
+
+
+def _is_env_read(node: ast.AST):
+    """Returns (is_read, name_arg_node) for env-reading expressions."""
+    if isinstance(node, ast.Call):
+        chain = dotted(node.func)
+        if chain in ("os.environ.get", "environ.get", "os.getenv",
+                     "getenv", "os.environ.setdefault",
+                     "environ.setdefault"):
+            return True, (node.args[0] if node.args else None)
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.ctx, ast.Load) and \
+            dotted(node.value) in ("os.environ", "environ"):
+        return True, node.slice
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+            isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+            dotted(node.comparators[0]) in ("os.environ", "environ"):
+        return True, node.left
+    return False, None
+
+
+@register
+class KnobRegistryChecker(Checker):
+    name = "knob-registry"
+    doc = ("LIGHTHOUSE_TPU_* knobs are read only through "
+           "common/knobs.py typed accessors and must be declared "
+           "in its registry")
+
+    def _declared(self):
+        from ...common.knobs import KNOBS
+        return KNOBS
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        if path == ACCESSOR_MODULE:
+            return []
+        in_package = path.startswith("lighthouse_tpu/")
+        declared = self._declared()
+        out: List[Finding] = []
+
+        for node in ast.walk(tree):
+            is_read, name_node = _is_env_read(node)
+            if is_read:
+                name = str_const(name_node) if name_node is not None \
+                    else None
+                if in_package:
+                    what = f"of {name!r} " if name else ""
+                    out.append(Finding(
+                        self.name, path, node.lineno,
+                        f"raw os.environ read {what}inside "
+                        f"lighthouse_tpu/ — all env reads go through "
+                        f"common/knobs.py",
+                        hint="use knob_bool/knob_int/knob_float/"
+                             "knob_str/knob_choice (declare the knob "
+                             "in KNOBS if it is new)",
+                        detail=f"env-read:{name or 'dynamic'}"))
+                elif name and KNOB_NAME_RE.fullmatch(name):
+                    out.append(Finding(
+                        self.name, path, node.lineno,
+                        f"raw os.environ read of knob {name!r} — "
+                        f"knob reads go through common/knobs.py",
+                        hint="use the typed accessor matching the "
+                             "knob's registry type",
+                        detail=f"env-read:{name}"))
+
+        # Undeclared (typo'd) knob names in ANY string literal.
+        seen = set()
+        for node in ast.walk(tree):
+            s = str_const(node)
+            if s is None:
+                continue
+            for name in KNOB_NAME_RE.findall(s):
+                if name not in declared and name not in seen:
+                    seen.add(name)
+                    out.append(Finding(
+                        self.name, path, node.lineno,
+                        f"undeclared knob name {name!r} — not in the "
+                        f"common/knobs.py registry (typo, or a knob "
+                        f"that was never declared)",
+                        hint="declare it in KNOBS with type/default/"
+                             "doc, or fix the spelling",
+                        detail=f"undeclared:{name}"))
+        return out

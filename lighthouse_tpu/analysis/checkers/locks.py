@@ -1,0 +1,123 @@
+"""lock-discipline — ``# guarded-by: <lock>`` annotations are enforced.
+
+The convention (the Rust reference gets this from ``Mutex<T>``'s type):
+a class declares which attributes a lock guards by trailing a
+``# guarded-by: _lock`` comment on the attribute's assignment
+(typically in ``__init__``).  Every OTHER method touching a guarded
+attribute must do so lexically inside ``with self._lock`` — the PR-7
+bug shape (peek-then-observe dedup: check under no lock, mutate under
+no lock, two pump threads both win) becomes a finding instead of a
+sixth review pass.
+
+Escape hatches, both explicit in source:
+
+- ``__init__`` is exempt (construction happens-before sharing).
+- a method whose ``def`` line carries ``# lock-held: _lock`` asserts
+  its callers hold the lock (private helpers called under the lock).
+
+Lexical only, by design: aliasing (``d = self._by_epoch`` then
+mutating ``d`` outside the lock) is NOT caught — keep guarded state
+access direct.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Sequence, Set
+
+from ..core import Checker, Context, Finding, register
+
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*(\w+)")
+HELD_RE = re.compile(r"#\s*lock-held:\s*(\w+)")
+
+
+def _stmt_lines(node: ast.stmt, lines: Sequence[str]) -> str:
+    end = getattr(node, "end_lineno", node.lineno)
+    return "\n".join(lines[node.lineno - 1:end])
+
+
+def _self_attr(node: ast.AST):
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = "lock-discipline"
+    doc = ("attributes annotated '# guarded-by: <lock>' may only be "
+           "touched inside 'with self.<lock>' (or in methods marked "
+           "'# lock-held: <lock>')")
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            guarded = self._guarded_attrs(cls, lines)
+            if not guarded:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__":
+                    continue
+                held: Set[str] = set(
+                    HELD_RE.findall(lines[fn.lineno - 1]))
+                self._walk(fn, held, guarded, cls.name, fn.name,
+                           path, out)
+        return out
+
+    def _guarded_attrs(self, cls: ast.ClassDef,
+                       lines) -> Dict[str, str]:
+        """attr → lock name, from annotated assignments anywhere in
+        the class body."""
+        guarded: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            m = GUARD_RE.search(_stmt_lines(node, lines))
+            if not m:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr:
+                    guarded[attr] = m.group(1)
+        return guarded
+
+    def _walk(self, node: ast.AST, held: Set[str],
+              guarded: Dict[str, str], cls_name: str, fn_name: str,
+              path: str, out: List[Finding]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set(held)
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr:
+                    acquired.add(attr)
+                self._walk(item.context_expr, held, guarded,
+                           cls_name, fn_name, path, out)
+            for child in node.body:
+                self._walk(child, acquired, guarded, cls_name,
+                           fn_name, path, out)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in guarded \
+                and guarded[attr] not in held:
+            out.append(Finding(
+                self.name, path, node.lineno,
+                f"{cls_name}.{fn_name} touches self.{attr} "
+                f"(guarded-by {guarded[attr]}) outside "
+                f"'with self.{guarded[attr]}'",
+                hint=f"wrap the access in 'with self.{guarded[attr]}:'"
+                     f" or mark the method '# lock-held: "
+                     f"{guarded[attr]}' if every caller holds it",
+                detail=f"{cls_name}.{fn_name}.{attr}"))
+            return  # one finding per access site; still walk siblings
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, guarded, cls_name, fn_name,
+                       path, out)

@@ -1,0 +1,66 @@
+"""readme-drift — the README knob table matches the registry.
+
+The "Static analysis & knobs" README section carries a table of every
+``LIGHTHOUSE_TPU_*`` knob, generated from ``common/knobs.py``'s
+registry between ``<!-- knobs:begin -->`` / ``<!-- knobs:end -->``
+markers.  Docs that drift from the registry are worse than no docs —
+this checker fails the lint until ``scripts/lint.py --fix-readme``
+re-renders the committed section.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, List
+
+from ..core import Checker, Context, Finding, register
+
+BEGIN = "<!-- knobs:begin -->"
+END = "<!-- knobs:end -->"
+SECTION_RE = re.compile(re.escape(BEGIN) + r"\n(.*?)" + re.escape(END),
+                        re.S)
+
+
+def committed_table(readme_text: str):
+    m = SECTION_RE.search(readme_text)
+    return m.group(1) if m else None
+
+
+def replace_table(readme_text: str, table: str) -> str:
+    # lambda replacement: the table is literal text, not a re template
+    # (a backslash in a knob doc must not be parsed as an escape).
+    return SECTION_RE.sub(lambda m: BEGIN + "\n" + table + END,
+                          readme_text)
+
+
+@register
+class ReadmeDriftChecker(Checker):
+    name = "readme-drift"
+    doc = ("the README knob table between the knobs:begin/end markers "
+           "equals the table generated from the knobs registry")
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        from ...common.knobs import render_knob_table
+        out: List[Finding] = []
+        path = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(path):
+            return out
+        with open(path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        committed = committed_table(text)
+        if committed is None:
+            out.append(Finding(
+                self.name, "README.md", 1,
+                f"README has no generated knob table ({BEGIN} … {END} "
+                f"markers missing)",
+                hint="run scripts/lint.py --fix-readme",
+                detail="markers-missing"))
+        elif committed != render_knob_table():
+            out.append(Finding(
+                self.name, "README.md", 1,
+                "README knob table drifted from the common/knobs.py "
+                "registry",
+                hint="run scripts/lint.py --fix-readme",
+                detail="table-drift"))
+        return out

@@ -1,0 +1,155 @@
+"""stage-source — ``LAST_*`` stage dicts are read through tracing only.
+
+PR 9 made ``common/tracing.py`` the ONE stage-data surface: bench rows
+and trace children both read the legacy per-module ``LAST_*`` stage
+dicts via ``tracing.stage_split(name)``, so the two never drift (the
+pre-PR-9 failure: a bench row read a module dict directly, a later
+refactor renamed a key, traces kept the old name, and the bench's
+"stage split" silently stopped matching the trace's).  Two invariants:
+
+1. **No direct foreign reads.**  Importing a ``LAST_*`` name from
+   another module, or reading ``module.LAST_*``, outside the defining
+   module and ``common/tracing.py``, is a finding — read
+   ``tracing.stage_split("<source>")``.
+
+2. **Every stage dict is registered.**  A module-level ``LAST_* = {}``
+   dict must be reachable through the adapter: either wired into
+   tracing's ``_STAGE_SOURCES`` table or self-registered via
+   ``tracing.register_stage_source(...)`` at module import (the
+   ``store/hot_cold.py`` idiom).  An unregistered stage dict is
+   invisible to traces and resurrects the direct-read temptation.
+
+The defining module itself may mutate its dict freely (bare-name
+access) — ownership stays local; only the READ surface is unified.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set
+
+from ..core import Checker, Context, Finding, register
+
+LAST_RE = re.compile(r"LAST_[A-Z0-9_]+")
+TRACING_MODULE = "lighthouse_tpu/common/tracing.py"
+
+
+def _is_dict_value(node: ast.AST) -> bool:
+    """A stage-dict definition is a dict LITERAL (or dict()/
+    OrderedDict() call) — not any LAST_-named constant (regexes,
+    tuples)."""
+    if isinstance(node, ast.Dict):
+        return True
+    return isinstance(node, ast.Call) and \
+        isinstance(node.func, ast.Name) and \
+        node.func.id in ("dict", "OrderedDict")
+
+
+@register
+class StageSourceChecker(Checker):
+    name = "stage-source"
+    doc = ("LAST_* stage dicts are read only via tracing.stage_split "
+           "and must be registered as stage sources")
+
+    def collect(self, ctx: Context, path: str, tree: ast.AST,
+                lines) -> None:
+        shared = ctx.shared.setdefault("stage", {
+            "defs": {},             # name -> (path, line)
+            "self_registered": set(),  # LAST_* names referenced inside
+                                       # a register_stage_source call
+            "tracing_names": set()
+        })
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and LAST_RE.fullmatch(t.id) \
+                        and _is_dict_value(node.value):
+                    shared["defs"].setdefault(t.id, (path, node.lineno))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                attr = fn.attr if isinstance(fn, ast.Attribute) else \
+                    (fn.id if isinstance(fn, ast.Name) else "")
+                if attr == "register_stage_source":
+                    # Per-DICT exemption: only the LAST_* names the
+                    # call's getter actually references count as
+                    # registered (a file-granular exemption would hide
+                    # a second, unregistered dict in the same module).
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and \
+                                LAST_RE.fullmatch(sub.id):
+                            shared["self_registered"].add(sub.id)
+        if path == TRACING_MODULE:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Name) and \
+                        LAST_RE.fullmatch(node.id):
+                    shared["tracing_names"].add(node.id)
+                if isinstance(node, ast.ImportFrom):
+                    for a in node.names:
+                        if LAST_RE.fullmatch(a.name):
+                            shared["tracing_names"].add(a.name)
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        if path == TRACING_MODULE:
+            return []
+        out: List[Finding] = []
+        own: Set[str] = {
+            t.id
+            for node in (tree.body if isinstance(tree, ast.Module)
+                         else [])
+            if isinstance(node, (ast.Assign, ast.AnnAssign))
+            for t in (node.targets if isinstance(node, ast.Assign)
+                      else [node.target])
+            if isinstance(t, ast.Name) and LAST_RE.fullmatch(t.id)
+        }
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if LAST_RE.fullmatch(a.name):
+                        out.append(Finding(
+                            self.name, path, node.lineno,
+                            f"direct import of stage dict {a.name} "
+                            f"from {node.module!r} — stage data is "
+                            f"read through the tracing adapter",
+                            hint="use tracing.stage_split("
+                                 "'<source name>') — one read surface "
+                                 "for bench rows and trace children",
+                            detail=f"import:{a.name}"))
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    LAST_RE.fullmatch(node.attr) and \
+                    node.attr not in own:
+                out.append(Finding(
+                    self.name, path, node.lineno,
+                    f"direct module-attribute read of stage dict "
+                    f".{node.attr} — stage data is read through the "
+                    f"tracing adapter",
+                    hint="use tracing.stage_split('<source name>')",
+                    detail=f"attr:{node.attr}"))
+        return out
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        shared = ctx.shared.get("stage", {})
+        defs: Dict[str, tuple] = shared.get("defs", {})
+        self_registered = shared.get("self_registered", set())
+        tracing_names = shared.get("tracing_names", set())
+        out: List[Finding] = []
+        for name, (path, line) in sorted(defs.items()):
+            if name in tracing_names or name in self_registered:
+                continue
+            out.append(Finding(
+                self.name, path, line,
+                f"stage dict {name} is not registered as a tracing "
+                f"stage source — invisible to slot traces and to "
+                f"stage_split readers",
+                hint="tracing.register_stage_source('<name>', lambda: "
+                     f"{name}) at module import, or wire a getter "
+                     "into tracing._STAGE_SOURCES",
+                detail=f"unregistered:{name}"))
+        return out

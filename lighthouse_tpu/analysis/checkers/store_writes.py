@@ -1,0 +1,70 @@
+"""store-write — no raw KV writes into framed columns outside store/.
+
+Since schema v2 every value row outside ``BeaconMeta`` carries a CRC32
+checksum frame (``store/kv.py``): a raw ``kv.put(DBColumn.X, ...)``
+from outside the store layer writes an UNFRAMED value that reads back
+as :class:`StoreCorruption` — a latent time bomb that only detonates
+on the next restart's recovery scan (the PR-10 review shape).  Writers
+outside ``lighthouse_tpu/store/`` must go through the ``HotColdDB`` op
+builders (``block_put_ops`` / ``state_put_ops`` / ``blob_put_ops`` /
+``item_put_op`` / ``journal_put_op``) committed via ``do_atomically``,
+which frame values and keep the one-batch-per-import crash contract.
+
+``DBColumn.BeaconMeta`` is exempt: it is deliberately unframed (the
+schema-version gate must be readable by ANY schema, and the slasher's
+counter rows live there).
+
+Lexical, literal-first-arg only: ``kv.put(col_var, ...)`` with a
+variable column is not caught — pass the DBColumn literally (the
+repo's idiom everywhere) so the checker can see it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Checker, Context, Finding, dotted, register
+
+STORE_PACKAGE = "lighthouse_tpu/store/"
+UNFRAMED = ("BeaconMeta",)
+
+
+@register
+class StoreWriteChecker(Checker):
+    name = "store-write"
+    doc = ("raw kv.put/kv.delete with a framed DBColumn outside "
+           "lighthouse_tpu/store/ — use the HotColdDB op builders")
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        if path.startswith(STORE_PACKAGE):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr not in ("put", "delete"):
+                continue
+            if not node.args:
+                continue
+            col = node.args[0]
+            chain = dotted(col) or ""
+            if not (chain == "DBColumn" or chain.startswith("DBColumn.")
+                    or ".DBColumn." in chain):
+                continue
+            col_name = chain.rsplit(".", 1)[-1]
+            if col_name in UNFRAMED:
+                continue
+            out.append(Finding(
+                self.name, path, node.lineno,
+                f"raw kv.{node.func.attr}(DBColumn.{col_name}, ...) "
+                f"outside lighthouse_tpu/store/ — schema-v2 rows in "
+                f"this column are CRC-framed; an unframed write reads "
+                f"back as StoreCorruption",
+                hint="build ops with the HotColdDB builders "
+                     "(block_put_ops/state_put_ops/blob_put_ops/"
+                     "item_put_op) and commit via do_atomically",
+                detail=f"DBColumn.{col_name}.{node.func.attr}"))
+        return out

@@ -1,0 +1,287 @@
+"""graftlint core — the AST-walking lint framework.
+
+The Rust reference gets lock discipline, exhaustive knob handling and
+API-misuse detection from rustc + clippy for free; this port encodes
+the same *repo-specific* invariants as AST checkers so review stops
+re-learning them (the PR-7/PR-9 review logs are the motivation: six
+passes each, every worst bug an instance of a statically checkable
+shape).
+
+Architecture
+------------
+
+- :class:`Finding` — one violation: ``path:line``, a message, a fix
+  hint, and a **stable waiver key** (``checker:path:detail`` — no line
+  numbers, so unrelated edits don't churn the baseline).
+- :class:`Checker` — subclass per invariant, registered with
+  :func:`register`.  Three phases: ``collect`` runs over EVERY file
+  first (cross-file facts: which module defines which stage dict),
+  then ``check`` per file, then ``finalize`` for whole-tree
+  invariants.
+- **Baseline** (``analysis/baseline.json``) — findings may be waived,
+  but every waiver MUST carry a written justification; an empty
+  justification is itself a lint failure.  Stale waivers (matching
+  nothing) are reported so the baseline only ever shrinks.
+
+Run via ``scripts/lint.py`` (exit 1 on any unwaived finding) or
+in-process through :func:`run` — the quick test tier asserts zero
+unwaived findings on the real tree, which is what makes every future
+PR cheaper to review.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One invariant violation."""
+    checker: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    message: str
+    hint: str = ""
+    detail: str = ""   # stable key component; defaults to the message
+
+    @property
+    def key(self) -> str:
+        """The baseline waiver key — deliberately line-free."""
+        return f"{self.checker}:{self.path}:{self.detail or self.message}"
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Checker registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Context:
+    """Per-run shared state (cross-file facts land in ``shared``)."""
+    root: str
+    files: Sequence[str] = ()
+    shared: dict = field(default_factory=dict)
+
+
+class Checker:
+    """Base class.  Subclass, set ``name``/``doc``, register."""
+
+    name: str = ""
+    doc: str = ""
+
+    def collect(self, ctx: Context, path: str, tree: ast.AST,
+                lines: Sequence[str]) -> None:
+        """First pass over every file — gather cross-file facts."""
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines: Sequence[str]) -> Iterable[Finding]:
+        """Second pass — per-file findings."""
+        return ()
+
+    def finalize(self, ctx: Context) -> Iterable[Finding]:
+        """After all files — whole-tree findings."""
+        return ()
+
+
+CHECKERS: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    assert cls.name and cls.name not in CHECKERS, cls
+    CHECKERS[cls.name] = cls
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# File discovery + run loop
+# ---------------------------------------------------------------------------
+
+# What graftlint covers: the package, the scripts, and the bench
+# driver.  tests/ is deliberately excluded — fixtures there CONTAIN
+# the forbidden shapes on purpose, and the env save/restore idiom
+# (read a knob to restore it in teardown) is legitimate test plumbing.
+DEFAULT_TARGETS: Tuple[str, ...] = ("lighthouse_tpu", "scripts", "bench.py")
+
+
+def lint_files(root: str,
+               targets: Sequence[str] = DEFAULT_TARGETS) -> List[str]:
+    """Repo-relative ``.py`` paths under ``targets``, sorted."""
+    out: List[str] = []
+    for target in targets:
+        full = os.path.join(root, target)
+        if os.path.isfile(full):
+            if target.endswith(".py"):
+                out.append(target.replace(os.sep, "/"))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+    return sorted(set(out))
+
+
+def _parse(root: str, rel: str):
+    with open(os.path.join(root, rel), "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return ast.parse(source, filename=rel), source.splitlines()
+
+
+def run(root: str, files: Optional[Sequence[str]] = None,
+        checker_names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run ``checker_names`` (default: all registered) over ``files``
+    (default: the standard lint set).  Returns findings sorted by
+    location.  ``collect`` always runs over the FULL lint set so
+    cross-file invariants hold even under ``--changed``."""
+    from . import checkers as _  # noqa: F401 — registration side effect
+
+    all_files = lint_files(root)
+    check_files = list(files) if files is not None else all_files
+    names = list(checker_names) if checker_names is not None \
+        else sorted(CHECKERS)
+    active = [CHECKERS[n]() for n in names]
+
+    findings: List[Finding] = []
+    parsed: Dict[str, tuple] = {}
+    for rel in all_files:
+        try:
+            parsed[rel] = _parse(root, rel)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                "parse", rel, int(exc.lineno or 0),
+                f"file does not parse: {exc.msg}",
+                detail="syntax-error"))
+
+    ctx = Context(root=root, files=all_files)
+    for rel in all_files:
+        if rel in parsed:
+            tree, lines = parsed[rel]
+            for c in active:
+                c.collect(ctx, rel, tree, lines)
+    for rel in check_files:
+        if rel in parsed:
+            tree, lines = parsed[rel]
+            for c in active:
+                findings.extend(c.check(ctx, rel, tree, lines))
+    for c in active:
+        findings.extend(c.finalize(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline (waivers)
+# ---------------------------------------------------------------------------
+
+BASELINE_PATH = os.path.join("lighthouse_tpu", "analysis", "baseline.json")
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(root: str) -> Dict[str, str]:
+    """``{waiver key: justification}``.  Missing file → empty.  A
+    waiver without a non-empty justification string is an error — the
+    baseline is a ledger of *argued* exceptions, not a mute list."""
+    path = os.path.join(root, BASELINE_PATH)
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    waivers = data.get("waivers")
+    if not isinstance(waivers, list):
+        raise BaselineError(f"{BASELINE_PATH}: expected a 'waivers' list")
+    out: Dict[str, str] = {}
+    for i, w in enumerate(waivers):
+        key = w.get("key") if isinstance(w, dict) else None
+        just = w.get("justification") if isinstance(w, dict) else None
+        if not key or not isinstance(key, str):
+            raise BaselineError(
+                f"{BASELINE_PATH}: waiver #{i} has no 'key'")
+        if not just or not isinstance(just, str) or not just.strip():
+            raise BaselineError(
+                f"{BASELINE_PATH}: waiver {key!r} has no written "
+                f"justification — every waiver must argue why the "
+                f"finding is acceptable")
+        if key in out:
+            raise BaselineError(
+                f"{BASELINE_PATH}: duplicate waiver {key!r}")
+        out[key] = just
+    return out
+
+
+def write_baseline(root: str, findings: Sequence[Finding],
+                   keep: Optional[Dict[str, str]] = None) -> int:
+    """Regenerate the baseline from ``findings``, preserving existing
+    justifications; new entries get an EMPTY justification that
+    :func:`load_baseline` will REJECT until a human writes the
+    argument.  Returns the number of entries written."""
+    keep = keep or {}
+    entries = []
+    for f in sorted({f.key: f for f in findings}.values(),
+                    key=lambda f: f.key):
+        entries.append({
+            "key": f.key,
+            "justification": keep.get(
+                f.key, ""),  # empty → load_baseline refuses
+            "message": f.message,
+        })
+    path = os.path.join(root, BASELINE_PATH)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "_doc": "graftlint waiver baseline. Every entry MUST carry "
+                    "a non-empty justification; scripts/lint.py "
+                    "--baseline regenerates keys but never invents "
+                    "arguments.",
+            "waivers": entries,
+        }, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, str]):
+    """Split into ``(unwaived, waived, stale_keys)``."""
+    keys = {f.key for f in findings}
+    unwaived = [f for f in findings if f.key not in baseline]
+    waived = [f for f in findings if f.key in baseline]
+    stale = sorted(k for k in baseline if k not in keys)
+    return unwaived, waived, stale
+
+
+# ---------------------------------------------------------------------------
+# Small shared AST helpers (used by several checkers)
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

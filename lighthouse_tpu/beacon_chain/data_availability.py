@@ -80,8 +80,8 @@ class DataAvailabilityChecker:
                 # A host-path verify leaves the device stage dict
                 # untouched; clear it so stale stages from a PREVIOUS
                 # device batch can't attach to this span.
-                from ..kzg.device import LAST_KZG_TIMINGS
-                LAST_KZG_TIMINGS.clear()
+                from ..kzg.device import reset_stage_timings
+                reset_stage_timings()
             if self.verify_batch_fn is not None:
                 ok = self.verify_batch_fn(blobs, commitments, proofs,
                                           self.setup)

@@ -17,11 +17,11 @@ class ObservedAttesters:
 
     def __init__(self, horizon_epochs: int = 2):
         self.horizon = horizon_epochs
-        self._by_epoch: Dict[int, Set[int]] = {}
         # observe() is the streaming path's atomic observe-if-fresh
         # primitive: concurrent completion callbacks (different pump
         # threads finishing duplicate gossip copies) race through the
         # check-then-add, and the GIL does not make that pair atomic.
+        self._by_epoch: Dict[int, Set[int]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, epoch: int, validator_index: int) -> bool:
@@ -36,7 +36,8 @@ class ObservedAttesters:
 
     def has_attested(self, epoch: int, validator_index: int) -> bool:
         """Peek (no recording) — the doppelganger liveness probe."""
-        return validator_index in self._by_epoch.get(epoch, set())
+        with self._lock:
+            return validator_index in self._by_epoch.get(epoch, set())
 
     def prune(self, current_epoch: int) -> None:
         # Same lock as observe(): a prune racing two concurrent observes
@@ -65,15 +66,20 @@ class ObservedBlockProducers:
 
     def __init__(self, horizon_slots: int = 64):
         self.horizon = horizon_slots
-        self._by_slot: Dict[int, Dict[int, bytes]] = {}
+        # Same atomic observe-if-fresh contract as ObservedAttesters:
+        # concurrent completion callbacks racing the check-then-set
+        # would let two DIFFERENT roots from one proposer both pass.
+        self._by_slot: Dict[int, Dict[int, bytes]] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
 
     def observe(self, slot: int, proposer_index: int,
                 block_root: bytes = b"") -> bool:
-        seen = self._by_slot.setdefault(slot, {})
-        if proposer_index in seen and seen[proposer_index] != block_root:
-            return False
-        seen[proposer_index] = block_root
-        return True
+        with self._lock:
+            seen = self._by_slot.setdefault(slot, {})
+            if proposer_index in seen and seen[proposer_index] != block_root:
+                return False
+            seen[proposer_index] = block_root
+            return True
 
     def has_been_observed(self, slot: int, proposer_index: int,
                           block_root: bytes = b"") -> bool:
@@ -82,9 +88,13 @@ class ObservedBlockProducers:
         junk cannot censor an honest proposer
         (`observed_block_producers.rs` proposer_has_been_observed vs
         observe_proposer two-phase)."""
-        seen = self._by_slot.get(slot, {})
-        return proposer_index in seen and seen[proposer_index] != block_root
+        with self._lock:
+            seen = self._by_slot.get(slot, {})
+            return proposer_index in seen \
+                and seen[proposer_index] != block_root
 
     def prune(self, current_slot: int) -> None:
-        for s in [s for s in self._by_slot if s + self.horizon < current_slot]:
-            del self._by_slot[s]
+        with self._lock:
+            for s in [s for s in self._by_slot
+                      if s + self.horizon < current_slot]:
+                del self._by_slot[s]

@@ -69,7 +69,6 @@ device timings.
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
@@ -100,18 +99,12 @@ class DeadlineExceeded(RuntimeError):
     abandoned on its watchdog thread; its eventual result is dropped)."""
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+# Knob reads go through the typed registry accessors — malformed
+# values raise an actionable KnobError instead of silently running
+# with the default.
+from ..common.knobs import knob_bool as _knob_bool
+from ..common.knobs import knob_float as _knob_float
+from ..common.knobs import knob_int as _knob_int
 
 
 # ---------------------------------------------------------------------------
@@ -571,22 +564,21 @@ class VerificationService:
                  host_verify: Optional[Callable] = None,
                  clock=time.monotonic, sleep=time.sleep,
                  auto_pump: bool = True, name: str = "stream"):
-        self.slo_s = (_env_float("LIGHTHOUSE_TPU_STREAM_SLO_MS", 250.0)
+        self.slo_s = (_knob_float("LIGHTHOUSE_TPU_STREAM_SLO_MS")
                       if slo_ms is None else float(slo_ms)) / 1e3
-        self.max_batch = (_env_int("LIGHTHOUSE_TPU_STREAM_MAX_BATCH", 256)
+        self.max_batch = (_knob_int("LIGHTHOUSE_TPU_STREAM_MAX_BATCH")
                           if max_batch is None else int(max_batch))
         self.max_pending_attestations = int(max_pending_attestations)
         self.max_pending_total = int(max_pending_total)
         if deadline_ms is None:
-            deadline_ms = _env_float("LIGHTHOUSE_TPU_VERIFY_DEADLINE_MS",
-                                     8000.0)
+            deadline_ms = _knob_float("LIGHTHOUSE_TPU_VERIFY_DEADLINE_MS")
         # 0 (or negative) = deadline DISABLED, not a zero-second
         # deadline: a 0 s watchdog would abandon every attempt at birth
         # and serve all traffic from host fallback while the abandoned
         # threads still run the device call to completion.
         deadline_s = None if deadline_ms <= 0 else deadline_ms / 1e3
         if breaker_threshold is None:
-            breaker_threshold = _env_int("LIGHTHOUSE_TPU_BREAKER_N", 5)
+            breaker_threshold = _knob_int("LIGHTHOUSE_TPU_BREAKER_N")
         self._clock = clock
         self._faults = faults
         self._device_verify = device_verify
@@ -1032,12 +1024,12 @@ def global_bls_envelope() -> ResilienceEnvelope:
     global _GLOBAL_ENVELOPE
     with _GLOBAL_LOCK:
         if _GLOBAL_ENVELOPE is None:
-            d_ms = _env_float("LIGHTHOUSE_TPU_VERIFY_DEADLINE_MS", 8000.0)
+            d_ms = _knob_float("LIGHTHOUSE_TPU_VERIFY_DEADLINE_MS")
             _GLOBAL_ENVELOPE = ResilienceEnvelope(
                 "bls_global",
                 deadline_s=None if d_ms <= 0 else d_ms / 1e3,
                 retries=2,
-                breaker_threshold=_env_int("LIGHTHOUSE_TPU_BREAKER_N", 5))
+                breaker_threshold=_knob_int("LIGHTHOUSE_TPU_BREAKER_N"))
         return _GLOBAL_ENVELOPE
 
 
@@ -1063,7 +1055,7 @@ def install_global_envelope() -> bool:
     disables).  Each successful install takes one refcount — pair it
     with :func:`release_global_envelope` at teardown."""
     global _GLOBAL_INSTALLS
-    if os.environ.get("LIGHTHOUSE_TPU_RESILIENT", "1") == "0":
+    if not _knob_bool("LIGHTHOUSE_TPU_RESILIENT"):
         return False
     from ..crypto import bls
     with _GLOBAL_LOCK:

@@ -31,11 +31,10 @@ _state = {"dir": None}
 
 def default_dir() -> str:
     """``LH_TPU_JAX_CACHE`` or ``<repo>/.jax_cache`` (the directory
-    bench.py and the tests already share)."""
-    return os.environ.get(
-        "LH_TPU_JAX_CACHE",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    bench.py and the tests already share; the registry default IS the
+    real repo-relative path)."""
+    from .knobs import knob_str
+    return knob_str("LH_TPU_JAX_CACHE")
 
 
 def enable(cache_dir: Optional[str] = None,

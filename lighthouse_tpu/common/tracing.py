@@ -49,7 +49,6 @@ Surfaced by ``/lighthouse/tracing/slots`` +
 from __future__ import annotations
 
 import itertools
-import os
 import threading
 import time
 from collections import OrderedDict
@@ -166,17 +165,15 @@ class Tracer:
     reading traces."""
 
     def __init__(self, max_slots: Optional[int] = None):
-        self.enabled = os.environ.get("LIGHTHOUSE_TPU_TRACE", "0") \
-            in ("1", "true", "yes")
-        try:
-            ring = int(os.environ.get("LIGHTHOUSE_TPU_TRACE_RING", "64"))
-        except ValueError:
-            ring = 64
+        from .knobs import knob_bool, knob_int
+        self.enabled = knob_bool("LIGHTHOUSE_TPU_TRACE")
+        ring = knob_int("LIGHTHOUSE_TPU_TRACE_RING")
         self.max_slots = max(1, max_slots if max_slots is not None else ring)
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._ids = itertools.count(1)
-        self._slots: "OrderedDict[int, dict]" = OrderedDict()
+        self._slots: "OrderedDict[int, dict]" = \
+            OrderedDict()  # guarded-by: _lock
         self._ambient_slot = 0
         self.evicted_slots = 0
         self.dropped_stale = 0  # spans for slots older than the ring
@@ -506,6 +503,16 @@ def _src_residency() -> dict:
     return RESIDENCY_STATS
 
 
+def _src_pipeline() -> dict:
+    from ..crypto.tpu_backend import LAST_PIPELINE_STATS
+    return LAST_PIPELINE_STATS
+
+
+def _src_materialize() -> dict:
+    from ..types.device_state import LAST_MATERIALIZE_STATS
+    return LAST_MATERIALIZE_STATS
+
+
 _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "block": _src_block,
     "epoch": _src_epoch,
@@ -515,6 +522,8 @@ _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "kzg": _src_kzg,
     "bls_kernels": _src_bls_kernels,
     "residency": _src_residency,
+    "pipeline": _src_pipeline,
+    "materialize": _src_materialize,
 }
 
 

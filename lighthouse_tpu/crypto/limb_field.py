@@ -231,13 +231,13 @@ def use_mxu() -> bool:
     """Whether band products route through the MXU matmul formulation."""
     global _MXU_FLAG
     if _MXU_FLAG is None:
-        import os
-        v = os.environ.get("LIGHTHOUSE_TPU_MXU", "auto").lower()
-        if v in ("auto", ""):
+        from ..common.knobs import knob_tribool
+        forced = knob_tribool("LIGHTHOUSE_TPU_MXU")
+        if forced is None:
             import jax
             _MXU_FLAG = jax.default_backend() == "tpu"
         else:
-            _MXU_FLAG = v not in ("0", "off", "false", "no")
+            _MXU_FLAG = forced
     return _MXU_FLAG
 
 

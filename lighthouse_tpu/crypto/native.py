@@ -148,7 +148,10 @@ def ready() -> bool:
     """The standard hot-path gate: honors LIGHTHOUSE_TPU_NO_NATIVE,
     kicks the async build, and answers WITHOUT blocking — callers fall
     back to pure python until the build lands."""
-    if os.environ.get("LIGHTHOUSE_TPU_NO_NATIVE"):
+    from ..common.knobs import knob_bool
+    # A typed read, not bare truthiness: NO_NATIVE=0 must keep the
+    # native backend ENABLED (the bare-truthy read treated "0" as set).
+    if knob_bool("LIGHTHOUSE_TPU_NO_NATIVE"):
         return False
     prebuild_async()
     return available(block=False)

@@ -419,10 +419,8 @@ def _pipeline_sets() -> int:
     launch overheads) amortize over 4× more sets — the r5 stage profile
     put final_exp at 51.7 ms against 32.4 ms of C=2 Miller, i.e. the
     fixed tail dominated narrow buckets."""
-    try:
-        return int(os.environ.get("LIGHTHOUSE_TPU_PIPELINE_SETS", "1024"))
-    except ValueError:
-        return 1024
+    from ..common.knobs import knob_int
+    return knob_int("LIGHTHOUSE_TPU_PIPELINE_SETS")
 
 
 def _split_batches(entries) -> list:
@@ -577,10 +575,8 @@ def _shared_min_sets() -> int:
     """Batch size from which the collapsed path wins (two fixed Miller
     lanes + final exp amortize); below it the general path's latency is
     comparable and not worth a second compiled program."""
-    try:
-        return int(os.environ.get("LIGHTHOUSE_TPU_SHARED_MIN", "8"))
-    except ValueError:
-        return 8
+    from ..common.knobs import knob_int
+    return knob_int("LIGHTHOUSE_TPU_SHARED_MIN")
 
 
 def _shared_group_key(entries):
@@ -814,11 +810,8 @@ def _host_fastpath_max() -> int:
     are latency-bound on dispatch, not compute.  Default crossover 4;
     co-located deployments (µs dispatch) should set
     LIGHTHOUSE_TPU_HOST_FASTPATH_MAX=0 to keep everything on-device."""
-    import os
-    try:
-        return int(os.environ.get("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX", "4"))
-    except ValueError:
-        return 4
+    from ..common.knobs import knob_int
+    return knob_int("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX")
 
 
 def _host_fast(n_sets: int) -> bool:

@@ -34,7 +34,6 @@ so the differential suite is quick-tier safe.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -62,17 +61,17 @@ _ENGINE_AUTO: Optional[str] = None
 def device_fork_choice_enabled() -> bool:
     """The oracle knob: ``LIGHTHOUSE_TPU_DEVICE_FORKCHOICE=0`` routes
     :class:`~.fork_choice.ForkChoice` through the host proto-array."""
-    return os.environ.get("LIGHTHOUSE_TPU_DEVICE_FORKCHOICE", "1") != "0"
+    from ..common.knobs import knob_bool
+    return knob_bool("LIGHTHOUSE_TPU_DEVICE_FORKCHOICE")
 
 
 def _resolve_engine(engine: Optional[str]) -> str:
     if engine in ("numpy", "jit"):
         return engine
-    env = os.environ.get("LIGHTHOUSE_TPU_FORKCHOICE_JIT")
-    if env == "1":
-        return "jit"
-    if env == "0":
-        return "numpy"
+    from ..common.knobs import knob_tribool
+    forced = knob_tribool("LIGHTHOUSE_TPU_FORKCHOICE_JIT")
+    if forced is not None:
+        return "jit" if forced else "numpy"
     global _ENGINE_AUTO
     if _ENGINE_AUTO is None:
         try:
@@ -315,9 +314,9 @@ class DeviceProtoArrayForkChoice:
         # The fused kernel's fori_loop serializes one step per tree
         # level; past this depth (chain-shaped trees, long non-finality)
         # the round runs on host instead — mirrors stay in sync.
+        from ..common.knobs import knob_int
         self.jit_max_depth = jit_max_depth if jit_max_depth is not None \
-            else int(os.environ.get(
-                "LIGHTHOUSE_TPU_FORKCHOICE_JIT_MAX_DEPTH", "512"))
+            else knob_int("LIGHTHOUSE_TPU_FORKCHOICE_JIT_MAX_DEPTH")
         self._mirror: Optional[_DeviceMirror] = None
         self._topo_version = 0
         self._pending_new_b: Optional[np.ndarray] = None

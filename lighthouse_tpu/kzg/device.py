@@ -53,14 +53,21 @@ from .trusted_setup import TrustedSetup
 LAST_KZG_TIMINGS: Dict[str, float] = {}
 
 
+def reset_stage_timings() -> None:
+    """Clear the stage dict — the mutation surface OTHER modules use
+    (e.g. the availability gate before a host-path verify, so stale
+    device stages can't attach to a host span)."""
+    LAST_KZG_TIMINGS.clear()
+
+
 def device_default() -> bool:
     """Route batches to the device only on a real TPU backend — on CPU the
     Miller-scan compile dwarfs the work (same policy as the BLS
     backend's ``_use_pallas``).  LIGHTHOUSE_TPU_KZG_DEVICE=1/0 forces."""
-    import os
-    env = os.environ.get("LIGHTHOUSE_TPU_KZG_DEVICE")
-    if env is not None:
-        return env not in ("0", "false", "")
+    from ..common.knobs import knob_tribool
+    forced = knob_tribool("LIGHTHOUSE_TPU_KZG_DEVICE")
+    if forced is not None:
+        return forced
     try:
         return jax.default_backend() == "tpu"
     except Exception:
@@ -206,7 +213,7 @@ def verify_blob_kzg_proof_batch_device(blobs, commitments, proofs,
     ok = bool(np.asarray(LP.multi_pairing_is_one(
         jnp.asarray(g1_lanes), jnp.asarray(g2_lanes), jnp.asarray(mask))))
     t_pair = time.perf_counter()
-    LAST_KZG_TIMINGS.clear()
+    reset_stage_timings()
     LAST_KZG_TIMINGS.update({
         "blobs": B,
         "lanes": lanes,

@@ -31,7 +31,6 @@ and the cross-chunk tail pairs naturally via :func:`..ops.merkle.merkleize`.
 
 from __future__ import annotations
 
-import os
 import time
 from functools import lru_cache, partial
 
@@ -249,11 +248,9 @@ def _push_chunk_rows() -> int:
     """The env knob, rounded DOWN to a power of two so it always
     divides the (power-of-two) leaf widths — a non-divisor value must
     tune the pipeline, not silently disable it.  ≤ 0 disables."""
-    try:
-        rows = int(os.environ.get("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS",
-                                  str(PUSH_CHUNK_ROWS)))
-    except ValueError:
-        return PUSH_CHUNK_ROWS
+    from ..common.knobs import knob_int
+    rows = knob_int("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS",
+                    default=PUSH_CHUNK_ROWS)
     return 1 << (rows.bit_length() - 1) if rows > 0 else 0
 
 

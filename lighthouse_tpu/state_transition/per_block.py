@@ -212,8 +212,8 @@ def _batched_atts_enabled() -> bool:
     """Vectorized attestation processing knob: on unless
     ``LIGHTHOUSE_TPU_BATCHED_ATTS=0`` (the scalar spec path is the
     differential oracle — see README "State transition")."""
-    import os
-    return os.environ.get("LIGHTHOUSE_TPU_BATCHED_ATTS", "1") != "0"
+    from ..common.knobs import knob_bool
+    return knob_bool("LIGHTHOUSE_TPU_BATCHED_ATTS")
 
 
 def process_operations(state, body, fork, preset, spec, T, acc,

@@ -435,15 +435,15 @@ LAST_EPOCH_TIMINGS: dict = {}
 def _single_pass_enabled() -> bool:
     """Fused-epoch knob: on unless ``LIGHTHOUSE_TPU_SINGLE_PASS_EPOCH=0``
     (the stepwise path is the differential oracle)."""
-    import os
-    return os.environ.get("LIGHTHOUSE_TPU_SINGLE_PASS_EPOCH", "1") != "0"
+    from ..common.knobs import knob_bool
+    return knob_bool("LIGHTHOUSE_TPU_SINGLE_PASS_EPOCH")
 
 
 def _epoch_device_enabled() -> bool:
     """``LIGHTHOUSE_TPU_EPOCH_DEVICE=1`` routes the fused rewards/inactivity
     sweep through the jitted device kernel (per_epoch_device)."""
-    import os
-    return os.environ.get("LIGHTHOUSE_TPU_EPOCH_DEVICE", "0") == "1"
+    from ..common.knobs import knob_bool
+    return knob_bool("LIGHTHOUSE_TPU_EPOCH_DEVICE")
 
 
 @dataclass

@@ -19,7 +19,6 @@ time, never silently decoded into a wrong state.
 
 from __future__ import annotations
 
-import os
 import sqlite3
 import struct
 import threading
@@ -157,8 +156,9 @@ class SqliteStore(KeyValueStore):
     def __init__(self, path: str, sync: Optional[str] = None):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
-        sync = (sync or os.environ.get("LIGHTHOUSE_TPU_STORE_SYNC",
-                                       "normal")).lower()
+        from ..common.knobs import knob_choice
+        sync = sync.lower() if sync \
+            else knob_choice("LIGHTHOUSE_TPU_STORE_SYNC")
         if sync not in _SYNC_LEVELS:
             raise ValueError(
                 f"LIGHTHOUSE_TPU_STORE_SYNC={sync!r}: expected one of "

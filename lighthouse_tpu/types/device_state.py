@@ -33,7 +33,6 @@ randomized mutation interleavings.
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 
@@ -61,7 +60,8 @@ def device_state_enabled() -> bool:
     """Master knob: device-resident state unless
     ``LIGHTHOUSE_TPU_DEVICE_STATE=0`` (the host incremental path is the
     differential oracle — README "Device-resident state")."""
-    return os.environ.get("LIGHTHOUSE_TPU_DEVICE_STATE", "1") != "0"
+    from ..common.knobs import knob_bool
+    return knob_bool("LIGHTHOUSE_TPU_DEVICE_STATE")
 
 
 def is_materialized(state) -> bool:

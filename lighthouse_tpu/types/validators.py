@@ -542,12 +542,9 @@ def _reg_chunk_rows() -> int:
     clamped to a usable multiple of the Pallas pad so a small-but-
     positive value still chunks instead of silently going monolithic.
     ≤ 0 disables."""
-    import os
-    try:
-        rows = int(os.environ.get("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS",
-                                  str(REG_PUSH_CHUNK_ROWS)))
-    except ValueError:
-        return REG_PUSH_CHUNK_ROWS
+    from ..common.knobs import knob_int
+    rows = knob_int("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS",
+                    default=REG_PUSH_CHUNK_ROWS)
     if rows <= 0:
         return 0
     return max((rows // _PALLAS_PAD) * _PALLAS_PAD, _PALLAS_PAD)

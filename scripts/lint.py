@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""graftlint CLI — run the repo's static-analysis pass.
+
+Usage:
+    python scripts/lint.py                 # lint the tree, exit 1 on
+                                           # any unwaived finding
+    python scripts/lint.py --changed       # only files touched per
+                                           # git (fast pre-commit)
+    python scripts/lint.py --baseline      # regenerate the waiver
+                                           # baseline (justifications
+                                           # preserved; NEW entries
+                                           # need one written by hand)
+    python scripts/lint.py --fix-readme    # re-render the README knob
+                                           # table from the registry
+    python scripts/lint.py --list          # list checkers
+
+Pure host logic — no jax import, no device: safe anywhere, fast
+everywhere (the whole tree lints in ~1 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lighthouse_tpu import analysis  # noqa: E402
+from lighthouse_tpu.analysis.checkers import readme_drift  # noqa: E402
+from lighthouse_tpu.common.knobs import render_knob_table  # noqa: E402
+
+
+def changed_files() -> list:
+    """Lintable files touched per git (staged + unstaged + untracked),
+    intersected with the standard lint set."""
+    # --untracked-files=all: the default collapses an untracked
+    # directory to one "dir/" entry, hiding every file inside it.
+    # -z: NUL-separated, UNQUOTED paths (the default C-quotes
+    # non-ASCII names, which would never intersect the lint set).
+    out = subprocess.run(
+        ["git", "-C", REPO, "status", "--porcelain", "-z",
+         "--untracked-files=all"],
+        capture_output=True, text=True, check=True).stdout
+    touched = set()
+    fields = iter(out.split("\0"))
+    for field in fields:
+        if len(field) < 4:
+            continue
+        touched.add(field[3:])
+        if field[0] in "RC":  # rename/copy: next field is the OLD path
+            next(fields, None)
+    lintable = set(analysis.lint_files(REPO))
+    return sorted(touched & lintable)
+
+
+def fix_readme() -> int:
+    path = os.path.join(REPO, "README.md")
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    if readme_drift.committed_table(text) is None:
+        print(f"README.md: {readme_drift.BEGIN} … {readme_drift.END} "
+              f"markers not found — add them where the knob table "
+              f"belongs, then re-run", file=sys.stderr)
+        return 1
+    new = readme_drift.replace_table(text, render_knob_table())
+    if new == text:
+        print("README knob table already up to date")
+        return 0
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(new)
+    print("README knob table re-rendered from the registry")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only git-touched files")
+    ap.add_argument("--baseline", action="store_true",
+                    help="regenerate the waiver baseline")
+    ap.add_argument("--fix-readme", action="store_true",
+                    help="re-render the README knob table")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered checkers")
+    ap.add_argument("files", nargs="*",
+                    help="explicit files to lint (default: the tree)")
+    args = ap.parse_args(argv)
+
+    if args.fix_readme:
+        return fix_readme()
+    if args.baseline and (args.changed or args.files):
+        # A subset run sees only a slice of the findings; regenerating
+        # from it would silently delete every out-of-subset waiver
+        # (and its hand-written justification).
+        print("graftlint: --baseline requires a full-tree run "
+              "(drop --changed / file arguments)", file=sys.stderr)
+        return 2
+    if args.list:
+        from lighthouse_tpu.analysis import checkers as _  # noqa
+        for name in sorted(analysis.CHECKERS):
+            print(f"{name:18s} {analysis.CHECKERS[name].doc}")
+        return 0
+
+    files = None
+    if args.files:
+        files = [os.path.relpath(os.path.abspath(f), REPO)
+                 .replace(os.sep, "/") for f in args.files]
+        unknown = sorted(set(files) - set(analysis.lint_files(REPO)))
+        if unknown:
+            # A mistyped path silently linting nothing would read as a
+            # clean pass — refuse instead.
+            for f in unknown:
+                print(f"graftlint: {f}: not in the lint set "
+                      f"(lighthouse_tpu/, scripts/, bench.py)",
+                      file=sys.stderr)
+            return 2
+    elif args.changed:
+        files = changed_files()
+        if not files:
+            print("graftlint: no lintable files changed")
+            return 0
+
+    findings = analysis.run(REPO, files=files)
+
+    if args.baseline:
+        try:
+            keep = analysis.load_baseline(REPO)
+        except analysis.BaselineError:
+            # Regenerating FROM a baseline with missing justifications:
+            # keep whatever arguments exist, drop nothing silently.
+            import json
+            with open(os.path.join(REPO, analysis.BASELINE_PATH)) as fh:
+                raw = json.load(fh)
+            keep = {w.get("key"): w.get("justification") or ""
+                    for w in raw.get("waivers", [])
+                    if isinstance(w, dict) and w.get("key")}
+        n = analysis.write_baseline(REPO, findings, keep)
+        missing = sum(1 for f in {f.key for f in findings}
+                      if not keep.get(f))
+        print(f"baseline written: {n} waivers"
+              + (f" ({missing} need a justification written "
+                 f"before lint passes)" if missing else ""))
+        return 0
+
+    try:
+        baseline = analysis.load_baseline(REPO)
+    except analysis.BaselineError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 1
+
+    unwaived, waived, stale = analysis.apply_baseline(findings, baseline)
+    for f in unwaived:
+        print(f.render())
+    if stale and files is None:
+        # Only meaningful on full-tree runs: a --changed subset never
+        # sees most findings, so most waivers LOOK stale there.
+        for key in stale:
+            print(f"stale waiver (matches nothing — remove it): {key}",
+                  file=sys.stderr)
+    scope = f"{len(files)} changed file(s)" if files is not None \
+        else "tree"
+    print(f"graftlint: {scope}: {len(unwaived)} unwaived, "
+          f"{len(waived)} waived"
+          + (f", {len(stale)} stale waiver(s)"
+             if stale and files is None else ""))
+    return 1 if unwaived or (stale and files is None) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

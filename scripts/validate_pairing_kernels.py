@@ -43,8 +43,10 @@ def validate_kzg(n_blobs: int, width: int) -> None:
                                              use_device=False)
         t_host = time.time() - t0
         assert dev == host, f"{name}: device={dev} host={host} DISAGREE"
+        from lighthouse_tpu.common import tracing
         print(f"{name}: device={dev} ({round(t_dev, 2)}s) == host "
-              f"({round(t_host, 2)}s); stages={D.LAST_KZG_TIMINGS}",
+              f"({round(t_host, 2)}s); "
+              f"stages={tracing.stage_split('kzg')}",
               flush=True)
         assert dev == (name == "valid"), f"{name}: wrong verdict {dev}"
     print("kzg device reduction == host fallback OK", flush=True)

@@ -117,10 +117,10 @@ def _mutate_round(rng: np.random.Generator, state, k: int) -> None:
 
 
 def validate(n: int, mutations: int, seed: int, copy_every: int) -> int:
+    from lighthouse_tpu.common import tracing
     from lighthouse_tpu.ops.device_tree import (reset_residency_stats,
                                                 residency_snapshot)
-    from lighthouse_tpu.types.device_state import (LAST_MATERIALIZE_STATS,
-                                                   materialize_state)
+    from lighthouse_tpu.types.device_state import materialize_state
 
     host = _mk_state(n, seed)
     dev = _mk_state(n, seed)
@@ -129,8 +129,9 @@ def validate(n: int, mutations: int, seed: int, copy_every: int) -> int:
     if not materialize_state(dev):
         print("materialize_state declined (LIGHTHOUSE_TPU_DEVICE_STATE=0?)")
         return 1
-    print(f"materialize: {LAST_MATERIALIZE_STATS.get('materialize_ms')} ms, "
-          f"{LAST_MATERIALIZE_STATS.get('bytes_pushed')} bytes pushed "
+    mat = tracing.stage_split("materialize")
+    print(f"materialize: {mat.get('materialize_ms')} ms, "
+          f"{mat.get('bytes_pushed')} bytes pushed "
           f"(one-time)", flush=True)
     host.tree_hash_root()
 
