@@ -820,6 +820,49 @@ def _stream_verify_bench() -> dict:
     }
 
 
+def _sustained_slo_bench() -> dict:
+    """Sustained mainnet-cadence SLO drill (ISSUE 13): quick-size
+    compressed-time run of testing/sustained_load — a block per slot +
+    subnet attestation stream + committee aggregates through the real
+    gossip → processor → streaming-verify → fork-choice → op-pool
+    pipeline, with an injected device outage mid-run.  Reports the SLO
+    scoreboard: per-objective attainment + p50/p99, shed/fallback
+    counts, and the health-transition log (healthy → degraded →
+    healthy, attributed to the outage).  Pure host logic on the fake
+    backend — needs_device=False, unlosable."""
+    from lighthouse_tpu.testing.sustained_load import run_sustained
+
+    board = run_sustained(slots=12, slot_s=0.4, n_validators=64,
+                          faults_outage_slots=(4, 6), seed=0)
+    out = {
+        "sustained_slots": board["config"]["slots"],
+        "sustained_slot_s": board["config"]["slot_s"],
+        "sustained_wall_s": board["wall_s"],
+        "sustained_rate_atts_per_s": board["rate_atts_per_s"],
+        "sustained_messages": board["messages"]["submitted"],
+        "sustained_zero_loss": board["loss"]["zero_loss"],
+        "sustained_shed": board["messages"]["shed"],
+        "sustained_host_fallbacks": board["host_fallbacks"],
+        "sustained_health_final": board["health"]["state"],
+        "sustained_health_transitions": [
+            f"{t['from']}->{t['to']}"
+            + (f" ({','.join(t['reasons'])})" if t["reasons"] else "")
+            for t in board["health"]["transitions"]],
+        "sustained_outage_attributed":
+            board["fault_attribution"]["attributed"],
+    }
+    for row in board["objectives"]:
+        name = row["name"]
+        out[f"sustained_attainment_{name}"] = \
+            row["slow"].get("attainment")
+        if row["kind"] == "latency":
+            out[f"sustained_{name}_p50_ms"] = row["slow"].get("p50_ms")
+            out[f"sustained_{name}_p99_ms"] = row["slow"].get("p99_ms")
+        else:
+            out[f"sustained_{name}_rate"] = row["slow"].get("rate")
+    return out
+
+
 def _stage_split_bench() -> dict:
     """VERDICT r4 #2: the measured per-stage decomposition of the fused
     pipeline (marshal/hash/prepare/Miller/fold/finalize) — at the r5
@@ -1079,6 +1122,7 @@ def _probe_backend(timeout_s: float) -> str | None:
 _ROWS = [
     ("secure", _secure_channel_bench, "secure_channel", False),
     ("stream", _stream_verify_bench, "stream_verify", False),
+    ("sustained", _sustained_slo_bench, "sustained_slo", False),
     ("restart", _restart_recovery_bench, "restart_recovery", False),
     ("registry", _registry_htr_bench, "registry_htr_2e%d" % REG_LOG2,
      True),
@@ -1125,6 +1169,57 @@ def _host_fallback(probe_err: str) -> None:
                                    [name for name, _, _, _ in _ROWS])))
 
 
+# Previous combined snapshot (BENCH_LATEST.json), read ONCE at startup
+# before the per-row rewrites clobber it — the regression report's
+# baseline.
+_PREV_BENCH: dict = {}
+
+
+def _load_prev_bench() -> None:
+    try:
+        with open("BENCH_LATEST.json", "r") as fh:
+            prev = json.load(fh)
+        if isinstance(prev, dict):
+            _PREV_BENCH.update(prev)
+    except (OSError, ValueError):
+        pass
+
+
+def _regressions(merged: dict) -> dict:
+    """Noise-aware regression report vs the previous BENCH_LATEST.json
+    snapshot.  Rows already take min-of-several; this box's memory
+    bandwidth is ±40% noisy between runs, so only >2x deltas are
+    flagged — and the section is informational (rc stays 0; a flagged
+    row means "re-measure before believing", not "fail the run")."""
+    if not _PREV_BENCH:
+        return {"compared": 0, "flagged": [],
+                "note": "no previous BENCH_LATEST.json"}
+    flagged = []
+    compared = 0
+    for key, new in merged.items():
+        old = _PREV_BENCH.get(key)
+        if isinstance(new, bool) or isinstance(old, bool) \
+                or not isinstance(new, (int, float)) \
+                or not isinstance(old, (int, float)):
+            continue
+        if key.endswith("_ms"):
+            lower_better = True
+        elif key.endswith("_per_s"):
+            lower_better = False
+        else:
+            continue
+        if old <= 0 or new <= 0:
+            continue
+        compared += 1
+        worse_by = (new / old) if lower_better else (old / new)
+        if worse_by > 2.0:
+            flagged.append({"metric": key, "previous": old,
+                            "current": new,
+                            "worse_by": round(worse_by, 2)})
+    flagged.sort(key=lambda r: -r["worse_by"])
+    return {"compared": compared, "flagged": flagged}
+
+
 def main() -> None:
     host_only = "--host-only" in sys.argv[1:] \
         or os.environ.get("BENCH_HOST_ONLY") == "1"
@@ -1149,6 +1244,10 @@ def main() -> None:
     # emission).  Cold compiles legitimately run ~35 min, hence the
     # generous default.
     row_timeout = float(os.environ.get("BENCH_ROW_TIMEOUT_S", "2700"))
+
+    # Regression baseline: snapshot the PREVIOUS combined record before
+    # the per-row rewrites below clobber BENCH_LATEST.json.
+    _load_prev_bench()
 
     # Fail-fast backend probe: a wedged tunnel should cost the probe
     # timeout (60 s), not 2700 s of watchdog — and then degrade to the
@@ -1220,6 +1319,7 @@ def _combined(merged: dict, skipped: list) -> dict:
         **bls_row,
         "baseline": f"blst single-core estimate {BLST_EST_MS_PER_SET} ms/set",
         **merged,
+        "regressions": _regressions(merged),
         "skipped": skipped,
         "total_s": round(time.monotonic() - _T_START, 1),
     }

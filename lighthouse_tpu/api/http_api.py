@@ -489,8 +489,35 @@ class HttpApiServer:
         elif path == "/lighthouse/validator_monitor":
             mon = chain.validator_monitor
             h._json({"data": [] if mon is None else mon.summaries()})
+        elif path == "/lighthouse/slo":
+            # Full per-objective scoreboard: windowed attainment /
+            # error-budget burn, p50/p99, worst offending slots with
+            # their trace links, health-transition log.  tick(), not
+            # an unthrottled evaluate: a fast scraper must not churn
+            # window snapshots or step the hysteresis counter faster
+            # than the configured evaluation cadence (staleness is
+            # bounded by min_eval_interval_s).
+            engine = getattr(chain, "slo_engine", None)
+            if engine is None:
+                h._json({"code": 404, "message": "no SLO engine"}, 404)
+            else:
+                if engine.enabled:
+                    engine.tick()
+                h._json({"data": engine.report()})
         elif path.startswith("/lighthouse/health"):
-            h._json({"data": {"observed_attesters": "ok"}})
+            # Node health: 200 when healthy/degraded (the node serves),
+            # 503 when unhealthy (load balancers drain it).  An empty
+            # trace ring / fresh node answers 200 healthy.
+            engine = getattr(chain, "slo_engine", None)
+            if engine is None:
+                h._json({"data": {"state": "healthy", "reasons": [],
+                                  "enabled": False}})
+                return
+            if engine.enabled:
+                engine.tick()
+            body = engine.health()
+            h._json({"data": body},
+                    503 if body["state"] == "unhealthy" else 200)
         else:
             h._json({"code": 404, "message": "unknown route"}, 404)
 

@@ -177,6 +177,11 @@ def stream_verify_attestations(chain, service, attestations: List,
             chain.register_verified_attestation(
                 VerifiedAttestation(att, idx, committee))
 
-        if service.submit(kind, [sset], on_result, meta=att):
+        # The network layer stamps gossip arrival on the message; the
+        # service backdates its SLO clock to it, so the accounted
+        # latency is gossip→verified (processor queue wait included),
+        # not merely submit→verdict.
+        if service.submit(kind, [sset], on_result, meta=att,
+                          arrival=getattr(att, "_gossip_arrival", None)):
             submitted += 1
     return submitted

@@ -12,11 +12,13 @@ and the per-slot task (``:5322``).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import List, Optional
 
 import numpy as np
 
+from ..common.metrics import Histogram, observe
 from ..common.tracing import TRACER
 from ..fork_choice import ForkChoice
 from ..op_pool import OperationPool
@@ -137,11 +139,46 @@ class BeaconChain:
                                   slot=int(genesis_state.slot),
                                   state=genesis_state.copy())
         self.last_recovery = None
+        self._init_slo()
         # Anchor snapshot: a process killed before its first finalization
         # must still find a resumable chain in the datadir; every later
         # import's journal entry replays on top of this.
         self._persisted_finalized = self.fork_choice.finalized_checkpoint
         self.persist()
+
+    def _init_slo(self) -> None:
+        """SLO engine + node health (common/slo.py): objectives
+        evaluated from record-time aggregates at every slot tick.
+        Shared by ``__init__`` and the ``resume`` restart path (which
+        builds via ``__new__``).  The import histogram is chain-LOCAL
+        (unregistered) so a multi-node test process never mixes peers'
+        imports into one node's objective; bucket bounds bracket the
+        150 ms block budget exactly."""
+        from ..common.slo import (SloEngine, default_objectives,
+                                  wire_chain_feeds)
+        self._slo_import_hist = Histogram(
+            "block_import_seconds_local", "",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25,
+                     0.5, 1.0, 2.5, 5.0))
+        # import_failure_rate feed: a latency histogram only sees
+        # SUCCESSFUL imports — a node whose every import dies would
+        # read healthy on an empty window.  Plain ints (GIL-atomic
+        # increments; the feed reads them racily by design).
+        self._slo_import_attempts = 0
+        self._slo_import_failures = 0
+        slot_seconds = getattr(self.spec, "seconds_per_slot", 12)
+        # Evaluation cadence ≈ slot cadence: hysteresis counts
+        # EVALUATIONS, and the HTTP routes also tick — without this a
+        # 1 Hz scraper would step the debounce 6-12x faster than the
+        # slot ticks it was sized for (and flip /health's 503 drain
+        # signal on a transient stall two slot ticks would smooth).
+        # HALF a slot, not a full one: a timer tick arriving a few ms
+        # early against an exact-slot interval would be dropped,
+        # silently halving the cadence on jitter.
+        self.slo_engine = SloEngine(
+            default_objectives(slot_seconds),
+            min_eval_interval_s=slot_seconds / 2.0)
+        wire_chain_feeds(self.slo_engine, self)
 
     # -- restart persistence -------------------------------------------------
 
@@ -271,6 +308,8 @@ class BeaconChain:
         chain.lc_optimistic_update = None
         chain.lc_finality_update = None
         chain.lc_period_update = None
+        chain.last_recovery = None
+        chain._init_slo()
         chain._persisted_finalized = fc.finalized_checkpoint
         # Reconcile snapshot vs store and replay the post-snapshot
         # import window BEFORE computing the head.
@@ -335,6 +374,9 @@ class BeaconChain:
     def per_slot_task(self, slot: int) -> None:
         """`timer` service hook (`beacon_chain.rs:5322`)."""
         TRACER.set_slot(slot)  # ambient slot scope for this tick's spans
+        # SLO evaluation rides the timer tick (rate-limited inside) —
+        # off the import/verify hot paths by construction.
+        self.slo_engine.tick()
         self.fork_choice.on_tick(slot)
         self._drain_slasher(slot)
         self.observed_attesters.prune(slot // self.preset.SLOTS_PER_EPOCH)
@@ -513,6 +555,31 @@ class BeaconChain:
         :class:`~.errors.BlobsUnavailable` and is NOT imported — the
         network layer retries after fetching the blobs.
         """
+        t_import = time.perf_counter()
+        try:
+            out = self._process_block_inner(signed_block, t_import,
+                                            is_timely=is_timely,
+                                            blob_sidecars=blob_sidecars)
+        except BlockError:
+            # Peer-protocol rejections (invalid block, unknown parent,
+            # blobs pending, repeat proposal) are the NETWORK's fault —
+            # normal during sync and under hostile gossip: excluded
+            # from BOTH sides of the failure rate, or mesh-duplicate /
+            # junk deliveries would dilute the denominator and an
+            # import-dead node under hostile gossip would read healthy.
+            raise
+        except Exception:
+            # Infrastructure death (store corruption, wedged device,
+            # logic error): THIS is what the import_failure_rate
+            # objective drains the node on.
+            self._slo_import_attempts += 1
+            self._slo_import_failures += 1
+            raise
+        self._slo_import_attempts += 1
+        return out
+
+    def _process_block_inner(self, signed_block, t_import: float, *,
+                             is_timely: bool, blob_sidecars) -> bytes:
         with TRACER.span("block_import", cat="block_import",
                          slot=int(signed_block.message.slot)) as _sp:
             g = GossipVerifiedBlock.new(self, signed_block)
@@ -539,6 +606,13 @@ class BeaconChain:
                                                            ex)
                 raise
             self._import_block(ex, is_timely=is_timely)
+            # Record-time SLO aggregate: one observation per successful
+            # import (chain-local histogram for the block_import
+            # objective + the process-global family for /metrics).
+            dt = time.perf_counter() - t_import
+            self._slo_import_hist.observe(dt)
+            observe("block_import_seconds", dt,
+                    "block import wall (gossip verify → head update)")
             _sp.set(root=ex.block_root.hex())
             return ex.block_root
 

@@ -78,7 +78,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..common.backoff import backoff_delay
-from ..common.metrics import REGISTRY, observe
+from ..common.metrics import REGISTRY, Histogram, observe
 from ..common.tracing import TRACER
 from ..ops.merkle import _next_pow2
 
@@ -519,6 +519,12 @@ class _Submission:
     sets: List[object]              # bls.SignatureSet(s) of ONE message
     enqueued: float
     deadline: float                 # enqueued + SLO
+    arrival: float = 0.0            # gossip-arrival instant (= enqueued
+    #   when unknown): the LATENCY accounting clock only — queue policy
+    #   (deadline ordering, oldest-first shed) stays keyed on enqueued,
+    #   which is monotonic per bucket (submits happen in call order; a
+    #   backdated deadline would break the dq[0]-is-oldest invariant
+    #   _due_keys/_pop_oldest rely on under the processor's LIFO queues)
     on_result: Optional[Callable[[bool, str], None]] = None
     meta: object = None
     completed: bool = False         # _complete fired (idempotence guard)
@@ -530,6 +536,13 @@ class _Submission:
 # Verdict-latency histogram labeled by message kind — the labeled-family
 # exposition (`stream_verify_latency_seconds{kind="attestation"}`).
 _LATENCY_LABELS = ("kind",)
+
+# Per-SERVICE latency aggregate buckets (seconds): the SLO engine's
+# gossip_to_verified feed diffs this record-time histogram between
+# window snapshots, so the bounds are dense where per-message budgets
+# live (slot/3 at both mainnet 12 s and compressed drill slots).
+_SLO_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15,
+                        0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
 
 
 # Sync-contribution key lists at least this wide get a content
@@ -623,6 +636,13 @@ class VerificationService:
             labelnames=_LATENCY_LABELS)
         self._m_shed = REGISTRY.counter(
             "stream_verify_shed_total", "messages shed under overload")
+        # Service-LOCAL record-time latency aggregate (unregistered —
+        # the process-global family above is shared by every service in
+        # the process, so a per-chain SLO feed would mix other nodes'
+        # traffic in a simulator/test process).
+        self._slo_latency = Histogram(
+            "stream_verify_slo_latency_local", "",
+            buckets=_SLO_LATENCY_BUCKETS)
 
     # -- verify fns (resolved per call: the backend can switch) -------------
 
@@ -654,13 +674,31 @@ class VerificationService:
 
     def submit(self, kind: str, sets: Sequence[object],
                on_result: Optional[Callable[[bool, str], None]] = None,
-               meta: object = None) -> bool:
+               meta: object = None,
+               arrival: Optional[float] = None) -> bool:
         """Enqueue one message's signature set(s).  Returns False when
-        the message was shed at the door (attestation overload)."""
+        the message was shed at the door (attestation overload).
+
+        ``arrival`` optionally backdates the message's LATENCY clock to
+        its gossip-arrival instant (``time.monotonic`` domain): the
+        latency the SLO accounts then covers the processor queue wait
+        too — gossip→verified, not merely submit→verdict.  Batching
+        policy (deadline, shed order) stays keyed on the submit instant
+        (see :class:`_Submission`).  Ignored when the service runs on
+        an injected clock (drills) or when the stamp is in the future
+        (a foreign clock domain).  Arbitrarily OLD stamps are accepted:
+        a message that waited past the histogram's top bound records as
+        overflow (out-of-budget) — an upper cutoff here would blind the
+        gossip_to_verified objective to exactly the worst queue waits
+        it exists to catch."""
         now = self._clock()
+        arr = now
+        if arrival is not None and self._clock is time.monotonic \
+                and now - arrival >= 0.0:
+            arr = arrival
         sub = _Submission(kind=kind, sets=list(sets), enqueued=now,
-                          deadline=now + self.slo_s, on_result=on_result,
-                          meta=meta,
+                          deadline=now + self.slo_s, arrival=arr,
+                          on_result=on_result, meta=meta,
                           trace_ctx=TRACER.ctx() if TRACER.enabled
                           else None)
         shed: List[_Submission] = []
@@ -931,8 +969,15 @@ class VerificationService:
             if sub.completed:  # error-sweep vs normal path double-fire
                 return
             sub.completed = True
-        lat = self._clock() - sub.enqueued
+        now = self._clock()
+        # Two clocks, two meanings: the SERVICE metrics (labeled family,
+        # p50/p99 deque, slo_violations) stay submit→verdict — that is
+        # the batching policy's own deadline domain — while the SLO
+        # feed measures gossip-arrival→verified (queue wait included),
+        # which is the objective the operator cares about.
+        lat = now - sub.enqueued
         self._m_latency.labels(sub.kind).observe(lat)
+        self._slo_latency.observe(now - (sub.arrival or sub.enqueued))
         with self._lock:
             self.latencies.append(lat)
             self.counters["verified" if ok else "rejected"] += 1
@@ -975,6 +1020,19 @@ class VerificationService:
         return bool(ok)
 
     # -- introspection --------------------------------------------------------
+
+    def slo_counters(self) -> dict:
+        """Cumulative message counters, cheap enough for the SLO
+        engine's per-tick feeds (:meth:`stats` sorts the whole latency
+        deque — too heavy to call every evaluation)."""
+        with self._lock:
+            return dict(self.counters)
+
+    def latency_snapshot(self):
+        """Record-time per-service latency aggregate:
+        ``(buckets, counts, total, sum)`` — the gossip_to_verified SLO
+        feed."""
+        return self._slo_latency.snapshot()
 
     @staticmethod
     def _pct(sorted_vals: List[float], q: float) -> Optional[float]:

@@ -26,7 +26,77 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional, Sequence, Tuple
 
-_state = {"dir": None}
+_state = {"dir": None, "monitoring": False}
+
+
+# ---------------------------------------------------------------------------
+# Compile-cache observability.  JAX emits monitoring events on every
+# cache-eligible compile ('/jax/compilation_cache/compile_requests_use_
+# cache') and on every persistent-cache hit ('/jax/compilation_cache/
+# cache_hits'); a request without a hit is a miss — which on this box
+# costs MINUTES per pairing-scale program.  The listener feeds a labeled
+# counter family (`compile_cache_events_total{event="request"|"hit"}`)
+# and a scrape-time collector derives the miss count, so a cold-cache
+# node is visible on /metrics instead of just "mysteriously slow".
+# ---------------------------------------------------------------------------
+
+_EVENT_MAP = {
+    "/jax/compilation_cache/compile_requests_use_cache": "request",
+    "/jax/compilation_cache/cache_hits": "hit",
+}
+
+
+def _on_jax_event(event: str, **_kw) -> None:
+    label = _EVENT_MAP.get(event)
+    if label is None:
+        return
+    from .metrics import REGISTRY
+    REGISTRY.counter(
+        "compile_cache_events_total",
+        "persistent XLA compile-cache activity",
+        labelnames=("event",)).labels(label).inc()
+
+
+def _collect_cache_misses() -> None:
+    from .metrics import REGISTRY
+    fam = REGISTRY.counter(
+        "compile_cache_events_total",
+        "persistent XLA compile-cache activity",
+        labelnames=("event",))
+    requests = fam.labels("request").value
+    hits = fam.labels("hit").value
+    REGISTRY.gauge(
+        "compile_cache_misses",
+        "cache-eligible compiles not served from the persistent "
+        "cache").set(max(requests - hits, 0.0))
+
+
+def install_monitoring() -> bool:
+    """Register the jax monitoring listener (idempotent; a jax build
+    without the monitoring API degrades to counters that stay 0).
+
+    Called from :func:`enable` — the entry points that turn the
+    persistent cache on are exactly the processes whose hit/miss
+    traffic matters — NOT at module import: this module must stay
+    cheap to import (``default_dir`` readers shouldn't pay the
+    multi-second jax import)."""
+    if _state["monitoring"]:
+        return True
+    try:
+        from jax import monitoring as _mon  # public front
+    except Exception:
+        try:
+            from jax._src import monitoring as _mon  # older builds
+        except Exception:
+            return False
+    try:
+        _mon.register_event_listener(_on_jax_event)
+    except Exception:
+        return False
+    from .metrics import REGISTRY
+    REGISTRY.register_collector(_collect_cache_misses)
+    _state["monitoring"] = True
+    return True
 
 
 def default_dir() -> str:
@@ -45,6 +115,8 @@ def enable(cache_dir: Optional[str] = None,
     running JAX has no persistent-cache support (ancient builds — run
     uncached rather than fail)."""
     import jax
+
+    install_monitoring()  # hit/miss counters ride the cache lifecycle
 
     cache = os.path.abspath(cache_dir or default_dir())
     try:

@@ -159,6 +159,29 @@ _declare("LIGHTHOUSE_TPU_TRACE", "bool", False,
 _declare("LIGHTHOUSE_TPU_TRACE_RING", "int", 64,
          "Fully-assembled slot traces kept in the ring.", min_value=1)
 
+# -- SLO engine / node health --
+_declare("LIGHTHOUSE_TPU_SLO", "bool", True,
+         "Evaluate the declarative SLO registry and publish node "
+         "health (0 = engine constructed but never evaluated).")
+_declare("LIGHTHOUSE_TPU_SLO_FAST_WINDOW_S", "float", 60.0,
+         "Fast-burn rolling attainment window (SRE short window).",
+         min_value=0.1)
+_declare("LIGHTHOUSE_TPU_SLO_SLOW_WINDOW_S", "float", 360.0,
+         "Slow-burn rolling attainment window (SRE long window).",
+         min_value=0.1)
+_declare("LIGHTHOUSE_TPU_SLO_BLOCK_IMPORT_MS", "float", 150.0,
+         "block_import objective: p99 wall budget per block import.",
+         min_value=1.0)
+_declare("LIGHTHOUSE_TPU_SLO_SHED_PCT", "float", 0.1,
+         "shed_rate objective: max percent of submitted messages shed.",
+         min_value=0.0)
+_declare("LIGHTHOUSE_TPU_SLO_FALLBACK_PCT", "float", 1.0,
+         "host_fallback_rate objective: max percent of dispatches "
+         "served by the host oracle.", min_value=0.0)
+_declare("LIGHTHOUSE_TPU_SLO_HYSTERESIS", "int", 2,
+         "Consecutive evaluations a new health state must hold before "
+         "the node transitions.", min_value=1)
+
 # -- toolchain --
 # The registry default is the REAL repo-relative path (usable by any
 # accessor call); the README renders it as "<repo>/.jax_cache".

@@ -166,6 +166,15 @@ class Histogram(_LabeledFamily):
     def start_timer(self) -> "HistogramTimer":
         return HistogramTimer(self)
 
+    def snapshot(self) -> Tuple[Tuple[float, ...], Tuple[int, ...],
+                                int, float]:
+        """Consistent ``(buckets, per-bucket counts, total, sum)`` copy —
+        the record-time aggregate the SLO engine diffs between window
+        snapshots (never a per-observation list)."""
+        with self._lock:
+            return (self.buckets, tuple(self.counts), self.total,
+                    self.sum)
+
     def _header(self) -> str:
         return (f"# HELP {self.name} {_escape_help(self.help)}\n"
                 f"# TYPE {self.name} histogram\n")
@@ -220,7 +229,16 @@ class HistogramTimer:
 class Registry:
     def __init__(self):
         self._metrics: Dict[str, object] = {}
+        self._collectors: List = []
         self._lock = threading.Lock()
+
+    def register_collector(self, fn) -> None:
+        """Scrape-time refresher: ``fn()`` runs before every
+        :meth:`encode` so pull-model values (process RSS, fd count, GC
+        stats) are current at scrape without a background thread."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
 
     def _get(self, cls, name: str, help_: str, **kw):
         with self._lock:
@@ -250,6 +268,13 @@ class Registry:
     def encode(self) -> str:
         """Prometheus text exposition (the `/metrics` body)."""
         with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a broken collector must
+                pass           # never take the whole scrape down
+        with self._lock:
             metrics = sorted(self._metrics.items())
         return "".join(m.encode() for _, m in metrics)
 
@@ -271,3 +296,58 @@ def observe(name: str, value: float, help_: str = "") -> None:
     device pipeline uses (host-prep / transfer / compute / pull), where
     the section being timed spans threads and a timer guard can't."""
     REGISTRY.histogram(name, help_).observe(value)
+
+
+# ---------------------------------------------------------------------------
+# Process-level metrics (the classic node-observability gap): RSS, thread
+# count, open fds, uptime and GC collections as standard gauges refreshed
+# at scrape time.  Cardinality is bounded — plain gauges plus one labeled
+# family with exactly the three GC generations.
+# ---------------------------------------------------------------------------
+
+_PROCESS_T0 = time.monotonic()
+
+
+def _read_rss_bytes() -> Optional[int]:
+    """VmRSS from /proc (linux); None elsewhere — the RSS gauge is
+    then simply absent from the exposition (it is only created on the
+    first successful read; same contract as process_open_fds)."""
+    try:
+        with open("/proc/self/status", "r") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        return None
+    return None
+
+
+def _collect_process_metrics() -> None:
+    import gc
+    import os
+
+    rss = _read_rss_bytes()
+    if rss is not None:
+        REGISTRY.gauge("process_resident_memory_bytes",
+                       "resident set size").set(float(rss))
+    REGISTRY.gauge("process_threads",
+                   "live python threads").set(
+        float(threading.active_count()))
+    try:
+        n_fds = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        n_fds = None
+    if n_fds is not None:
+        REGISTRY.gauge("process_open_fds",
+                       "open file descriptors").set(float(n_fds))
+    REGISTRY.gauge("process_uptime_seconds",
+                   "seconds since metrics import").set(
+        time.monotonic() - _PROCESS_T0)
+    g = REGISTRY.gauge("process_gc_collections",
+                       "collector runs per GC generation",
+                       labelnames=("generation",))
+    for gen, stats in enumerate(gc.get_stats()):
+        g.labels(str(gen)).set(float(stats.get("collections", 0)))
+
+
+REGISTRY.register_collector(_collect_process_metrics)

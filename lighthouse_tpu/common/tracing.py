@@ -289,20 +289,39 @@ class Tracer:
                     # Aggregates maintained at record time so the slot
                     # summary never scans/copies span lists under the
                     # tracer lock (the lock every hot-path span exit
-                    # takes).
-                    "t0": rec["ts_us"], "t1": 0.0, "cats": set()}
+                    # takes).  "stats" adds per-category duration
+                    # aggregates ([count, sum_us, max_us]) — the SLO
+                    # engine's worst-offending-slot attribution reads
+                    # these, never the span lists.
+                    "t0": rec["ts_us"], "t1": 0.0, "cats": set(),
+                    "stats": {}}
                 while len(self._slots) > self.max_slots:
                     self._slots.pop(min(self._slots))
                     self.evicted_slots += 1
-            if len(bucket["spans"]) >= MAX_SPANS_PER_SLOT:
-                bucket["truncated"] += 1
-                return
-            bucket["spans"].append(rec)
+            # Record-time aggregates NEVER truncate (O(1) per span,
+            # bounded per slot): a hostile-flood slot past the span cap
+            # is exactly the slot the SLO worst-offender attribution
+            # must still rank correctly — only span STORAGE is capped.
             bucket["t0"] = min(bucket["t0"], rec["ts_us"])
             bucket["t1"] = max(bucket["t1"],
                                rec["ts_us"] + rec["dur_us"])
             if rec["cat"]:
                 bucket["cats"].add(rec["cat"])
+                if not rec.get("inst"):
+                    st = bucket["stats"].get(rec["cat"])
+                    if st is None:
+                        st = bucket["stats"][rec["cat"]] = [0, 0.0, 0.0]
+                    st[0] += 1
+                    st[1] += rec["dur_us"]
+                    st[2] = max(st[2], rec["dur_us"])
+            if len(bucket["spans"]) >= MAX_SPANS_PER_SLOT:
+                # Only span STORAGE is capped: fall through so the
+                # labeled histogram below keeps counting too — the
+                # Prometheus family and slot_stats() must agree on a
+                # flooded slot.
+                bucket["truncated"] += 1
+            else:
+                bucket["spans"].append(rec)
         cat = rec.get("cat")
         if cat and not rec.get("inst"):
             if self._m_spans is None:
@@ -396,6 +415,22 @@ class Tracer:
                 "truncated": b["truncated"],
                 "wall_ms": round(max(b["t1"] - b["t0"], 0.0) / 1e3, 3),
                 "stages": sorted(b["cats"]),
+            } for b in self._slots.values()]
+        out.sort(key=lambda r: r["slot"])
+        return out
+
+    def slot_stats(self) -> List[dict]:
+        """Per-slot per-category duration aggregates maintained at
+        record time: ``[{"slot", "stats": {cat: {"count", "total_ms",
+        "max_ms"}}}]`` — O(ring × cats) under the lock, never a span
+        scan.  The SLO engine's worst-offender attribution."""
+        with self._lock:
+            out = [{
+                "slot": b["slot"],
+                "stats": {cat: {"count": st[0],
+                                "total_ms": round(st[1] / 1e3, 3),
+                                "max_ms": round(st[2] / 1e3, 3)}
+                          for cat, st in b["stats"].items()},
             } for b in self._slots.values()]
         out.sort(key=lambda r: r["slot"])
         return out

@@ -177,6 +177,23 @@ class BeaconProcessor:
             processed += 1
         return processed
 
+    def quiescent(self) -> bool:
+        """True when nothing is queued, nothing is running and the
+        attached verification service owes no verdicts — the threaded
+        mode's drain predicate (the sustained-load drill's slot-end
+        settle; ``run_until_idle`` is the synchronous twin)."""
+        with self._lock:
+            if self._active or self._pumping \
+                    or any(self.queues.values()):
+                return False
+            # DUE reprocess entries count as pending work; future-dated
+            # ones don't (a deferred retry must not wedge the predicate).
+            if self._reprocess and self._reprocess[0][0] <= \
+                    time.monotonic():
+                return False
+        svc = self.verification_service
+        return svc is None or svc.pending() == 0
+
     # -- threaded mode -------------------------------------------------------
 
     def start(self) -> None:

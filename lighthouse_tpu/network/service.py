@@ -12,6 +12,7 @@ A production wire transport plugs in at the :class:`GossipBus` /
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -270,7 +271,15 @@ class NetworkNode:
             TRACER.instant("gossip_arrival", cat="gossip_arrival",
                            slot=int(atts[0].data.slot), kind="aggregate",
                            count=len(atts), node=self.name)
+        now = time.monotonic()  # SLO clock starts at gossip arrival
         for att in atts:
+            # Stamp-once: the in-process bus hands every subscriber the
+            # SAME object, and mesh redundancy redelivers it — the
+            # FIRST arrival is the honest gossip→verified clock start,
+            # and a later node/duplicate must not re-wind a stamp a
+            # pending verify is about to read.
+            if getattr(att, "_gossip_arrival", None) is None:
+                att._gossip_arrival = now
             self.processor.submit(WorkEvent(
                 WorkType.GossipAggregateBatch, att,
                 self._process_aggregate_batch))
@@ -283,7 +292,10 @@ class NetworkNode:
                            slot=int(atts[0].data.slot),
                            kind="attestation", count=len(atts),
                            node=self.name)
+        now = time.monotonic()  # SLO clock starts at gossip arrival
         for att in atts:
+            if getattr(att, "_gossip_arrival", None) is None:
+                att._gossip_arrival = now  # stamp-once (see above)
             self.processor.submit(WorkEvent(
                 WorkType.GossipAttestationBatch, att,
                 self._process_attestation_batch))

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..common.metrics import REGISTRY
 from ..types.chain_spec import Domain
 from .helpers import (
     compute_epoch_at_slot,
@@ -60,6 +61,16 @@ def committees_per_slot_count(active_count: int, preset) -> int:
         active_count // preset.SLOTS_PER_EPOCH // preset.TARGET_COMMITTEE_SIZE))
 
 
+# Shuffle-cache observability: every whole-epoch shuffle costs a full
+# active-set permutation, and until now the cache was blind — a
+# hit-rate collapse (state copies dropping caches, committee churn)
+# was invisible.  Bounded cardinality: one family, two outcomes.
+_SHUFFLE_CACHE_REQS = REGISTRY.counter(
+    "shuffle_cache_requests_total",
+    "whole-epoch committee shuffle cache lookups",
+    labelnames=("outcome",))
+
+
 def get_committee_cache(state, epoch: int, preset) -> CommitteeCache:
     """Relative-epoch cache (previous/current/next), attached to the state
     like the reference's ``committee_caches`` field
@@ -75,8 +86,11 @@ def get_committee_cache(state, epoch: int, preset) -> CommitteeCache:
             raise ValueError(
                 f"committee cache only covers epochs {cur - 1}..{cur + 1}, "
                 f"requested {epoch}")
+        _SHUFFLE_CACHE_REQS.labels("miss").inc()
         cache = CommitteeCache(state, epoch, preset)
         caches[epoch] = cache
+    else:
+        _SHUFFLE_CACHE_REQS.labels("hit").inc()
     return cache
 
 

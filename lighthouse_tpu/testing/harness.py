@@ -96,13 +96,12 @@ class StateHarness:
 
     # -- attestation building ------------------------------------------------
 
-    def attestations_for_slot(self, state, slot: int,
-                              participation: float = 1.0) -> list:
-        """One aggregate attestation per committee at ``slot``, signed by the
-        (first ``participation`` fraction of the) committee.
-
-        ``state`` must be advanced past ``slot`` so the block root exists.
-        """
+    def _committee_att_data(self, state, slot: int):
+        """Per-committee ``(index, committee, AttestationData, signing
+        root)`` tuples for ``slot`` — the ONE construction aggregates
+        AND single-bit attestations share (they must vote identical
+        AttestationData or a drill's aggregate conflicts with its own
+        singles)."""
         T, preset = self.T, self.preset
         epoch = compute_epoch_at_slot(slot, preset.SLOTS_PER_EPOCH)
         head_root = get_block_root_at_slot(state, slot, preset)
@@ -115,18 +114,33 @@ class StateHarness:
             source = state.current_justified_checkpoint
         else:
             source = state.previous_justified_checkpoint
+        domain = get_domain(state, Domain.BEACON_ATTESTER, epoch, preset)
         out = []
-        for index in range(get_committee_count_per_slot(state, epoch, preset)):
+        for index in range(get_committee_count_per_slot(state, epoch,
+                                                        preset)):
             committee = get_beacon_committee(state, slot, index, preset)
             data = T.AttestationData(
                 slot=slot, index=index, beacon_block_root=head_root,
                 source=T.Checkpoint(epoch=source.epoch, root=source.root),
                 target=T.Checkpoint(epoch=epoch, root=target_root))
+            out.append((index, committee, data,
+                        compute_signing_root(data, domain)))
+        return out
+
+    def attestations_for_slot(self, state, slot: int,
+                              participation: float = 1.0) -> list:
+        """One aggregate attestation per committee at ``slot``, signed by the
+        (first ``participation`` fraction of the) committee.
+
+        ``state`` must be advanced past ``slot`` so the block root exists.
+        """
+        T = self.T
+        out = []
+        for _index, committee, data, root in \
+                self._committee_att_data(state, slot):
             n_sign = max(1, int(len(committee) * participation))
             bits = np.zeros(len(committee), dtype=bool)
             bits[:n_sign] = True
-            domain = get_domain(state, Domain.BEACON_ATTESTER, epoch, preset)
-            root = compute_signing_root(data, domain)
             if _real_signing():
                 sig = B.aggregate_signatures([
                     interop_secret_key(int(v)).sign(root)
@@ -135,6 +149,30 @@ class StateHarness:
                 sig = _DUMMY_SIG
             out.append(T.Attestation(aggregation_bits=bits, data=data,
                                      signature=sig))
+        return out
+
+    def single_attestations_for_slot(self, state, slot: int,
+                                     fraction: float = 1.0) -> list:
+        """Unaggregated single-bit attestations — the subnet-gossip
+        shape the sustained-load drill streams.  One attestation per
+        committee member for the first ``fraction`` of each committee
+        at ``slot``, each with exactly its own aggregation bit set and
+        its own signature.  ``state`` must be advanced past ``slot``."""
+        T = self.T
+        out = []
+        for _index, committee, data, root in \
+                self._committee_att_data(state, slot):
+            n_sign = max(1, int(len(committee) * fraction))
+            for pos in range(n_sign):
+                bits = np.zeros(len(committee), dtype=bool)
+                bits[pos] = True
+                if _real_signing():
+                    sig = interop_secret_key(
+                        int(committee[pos])).sign(root).serialize()
+                else:
+                    sig = _DUMMY_SIG
+                out.append(T.Attestation(aggregation_bits=bits, data=data,
+                                         signature=sig))
         return out
 
     # -- sync aggregate ------------------------------------------------------

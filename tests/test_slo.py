@@ -1,0 +1,487 @@
+"""SLO engine & node health (ISSUE 13): burn-window math vs a
+hand-computed oracle, hysteresis on health transitions, the
+/lighthouse/slo + /lighthouse/health routes (incl. empty-ring 200),
+process/cache observability metrics, and the sustained-load drill at
+quick size (compressed time, fake backend) asserting zero loss +
+attainment computed — all quick-tier host logic."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.common import metrics as M
+from lighthouse_tpu.common.slo import (
+    DEGRADED,
+    HEALTHY,
+    UNHEALTHY,
+    Objective,
+    SloEngine,
+    default_objectives,
+    events_within,
+    hist_quantile,
+)
+from lighthouse_tpu.common.tracing import TRACER
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    TRACER.reset()
+    prev_ring = TRACER.max_slots
+    yield
+    TRACER.disable()
+    TRACER.reset()
+    TRACER.max_slots = prev_ring
+
+
+# ---------------------------------------------------------------------------
+# Window math vs hand-computed oracle
+# ---------------------------------------------------------------------------
+
+BUCKETS = (0.1, 0.2, 0.4)
+
+
+def test_events_within_oracle():
+    # counts: 4 in (0,0.1], 2 in (0.1,0.2], 2 in (0.2,0.4], 2 overflow
+    counts = (4, 2, 2, 2)
+    assert events_within(BUCKETS, counts, 0.1) == 4
+    # 0.15 splits the second bucket linearly: 4 + 2*(0.05/0.1) = 5
+    assert abs(events_within(BUCKETS, counts, 0.15) - 5.0) < 1e-9
+    assert events_within(BUCKETS, counts, 0.2) == 6
+    # 0.3 splits the third: 6 + 2*(0.1/0.2) = 7
+    assert abs(events_within(BUCKETS, counts, 0.3) - 7.0) < 1e-9
+    # at/above the last finite bound the overflow bucket NEVER counts
+    assert events_within(BUCKETS, counts, 0.4) == 8
+    assert events_within(BUCKETS, counts, 99.0) == 8
+    # budget below the first bound interpolates from zero
+    assert abs(events_within(BUCKETS, counts, 0.05) - 2.0) < 1e-9
+
+
+def test_hist_quantile_oracle():
+    counts = (4, 2, 2, 2)  # total 10
+    # p50: rank 5 → second bucket, 0.1 + 0.1*(1/2) = 0.15
+    assert abs(hist_quantile(BUCKETS, counts, 0.5) - 0.15) < 1e-9
+    # p20: rank 2 → first bucket, 0.1*(2/4) = 0.05
+    assert abs(hist_quantile(BUCKETS, counts, 0.2) - 0.05) < 1e-9
+    # p99: rank 9.9 → overflow: reports the last finite bound
+    assert hist_quantile(BUCKETS, counts, 0.99) == 0.4
+    assert hist_quantile(BUCKETS, (0, 0, 0, 0), 0.5) is None
+
+
+def _manual_engine(objective, **kw):
+    clk = {"t": 0.0}
+    state = {"val": None}
+    eng = SloEngine((objective,), clock=lambda: clk["t"], enabled=True,
+                    min_eval_interval_s=0.0, **kw)
+    eng.register_feed(objective.feed, lambda: state["val"])
+    return eng, clk, state
+
+
+def test_latency_burn_windows_vs_oracle():
+    obj = Objective("lat", feed="f", kind="latency", budget=0.1,
+                    percentile=0.9)
+    eng, clk, state = _manual_engine(obj, fast_window_s=5.0,
+                                     slow_window_s=20.0, hysteresis=1,
+                                     min_bad_events=0.0)
+    # t=0: empty
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 0), 0)
+    r = eng.evaluate()
+    row = r["objectives"][0]
+    assert row["fast"]["attainment"] is None
+    assert not row["burning"] and r["state"] == HEALTHY
+
+    # t=1: 10 events, 6 in budget → attainment 0.6,
+    # burn = (1-0.6)/(1-0.9) = 4.0 in BOTH windows (baseline = t0 snap)
+    state["val"] = ("hist", BUCKETS, (6, 0, 0, 4), 10)
+    clk["t"] = 1.0
+    row = eng.evaluate()["objectives"][0]
+    assert abs(row["fast"]["attainment"] - 0.6) < 1e-9
+    assert abs(row["fast"]["burn"] - 4.0) < 1e-9
+    assert abs(row["slow"]["burn"] - 4.0) < 1e-9
+    assert row["burning"] and eng.state == DEGRADED
+
+    # t=8: 40 MORE events all in budget.  Fast window (edge t=3) diffs
+    # against the t=1 snapshot → 40 events, attainment 1.0, burn 0.
+    # Slow window still sees the early bad mass: 50 events, 46 good →
+    # attainment 0.92, burn (1-0.92)/0.1 = 0.8.
+    state["val"] = ("hist", BUCKETS, (46, 0, 0, 4), 50)
+    clk["t"] = 8.0
+    row = eng.evaluate()["objectives"][0]
+    assert row["fast"]["attainment"] == 1.0
+    assert row["fast"]["burn"] == 0.0
+    assert abs(row["slow"]["attainment"] - 0.92) < 1e-9
+    assert abs(row["slow"]["burn"] - 0.8) < 1e-9
+    assert not row["burning"]  # multi-window: fast is clean
+    assert eng.evaluate()["state"] == HEALTHY
+    # windowed quantiles come from the diffed histogram
+    assert row["fast"]["p99_ms"] is not None
+
+
+def test_ratio_burn_vs_oracle():
+    obj = Objective("shed", feed="f", kind="ratio", budget=0.01,
+                    severity=UNHEALTHY)
+    eng, clk, state = _manual_engine(obj, fast_window_s=5.0,
+                                     slow_window_s=20.0, hysteresis=1,
+                                     min_bad_events=2.0)
+    state["val"] = ("ratio", 0, 0)
+    eng.evaluate()
+    # 4 bad of 100 → rate 0.04, burn 0.04/0.01 = 4 → unhealthy
+    state["val"] = ("ratio", 4, 100)
+    clk["t"] = 1.0
+    r = eng.evaluate()
+    row = r["objectives"][0]
+    assert abs(row["fast"]["rate"] - 0.04) < 1e-9
+    assert abs(row["fast"]["burn"] - 4.0) < 1e-9
+    assert r["state"] == UNHEALTHY
+    assert r["reasons"] == ["shed"]
+
+
+def test_single_straggler_never_pages():
+    # min_bad_events=2: one out-of-budget event of 24 reads as burn 4+
+    # on a p99 objective but must NOT flip health.
+    obj = Objective("lat", feed="f", kind="latency", budget=0.1,
+                    percentile=0.99)
+    eng, clk, state = _manual_engine(obj, fast_window_s=5.0,
+                                     slow_window_s=20.0, hysteresis=1,
+                                     min_bad_events=2.0)
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 0), 0)
+    eng.evaluate()
+    state["val"] = ("hist", BUCKETS, (23, 0, 0, 1), 24)
+    clk["t"] = 1.0
+    row = eng.evaluate()["objectives"][0]
+    assert row["fast"]["burn"] > 1.0  # it IS burning arithmetically
+    assert not row["burning"]         # but one straggler never pages
+    assert eng.state == HEALTHY
+    # a second straggler does page
+    state["val"] = ("hist", BUCKETS, (46, 0, 0, 2), 48)
+    clk["t"] = 2.0
+    row = eng.evaluate()["objectives"][0]
+    assert row["burning"] and eng.state == DEGRADED
+
+
+def test_hysteresis_on_transitions():
+    obj = Objective("lat", feed="f", kind="latency", budget=0.1,
+                    percentile=0.9)
+    eng, clk, state = _manual_engine(obj, fast_window_s=100.0,
+                                     slow_window_s=100.0, hysteresis=3,
+                                     min_bad_events=0.0)
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 0), 0)
+    eng.evaluate()
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 10), 10)
+    for i in range(1, 3):  # two burning evaluations: below hysteresis
+        clk["t"] = float(i)
+        assert eng.evaluate()["state"] == HEALTHY
+    clk["t"] = 3.0  # third consecutive: transition fires
+    r = eng.evaluate()
+    assert r["state"] == DEGRADED
+    assert len(r["transitions"]) == 1
+    assert r["transitions"][0]["from"] == HEALTHY
+    assert r["transitions"][0]["to"] == DEGRADED
+    assert r["transitions"][0]["reasons"] == ["lat"]
+
+
+def test_hysteresis_flapping_candidate_resets():
+    obj = Objective("lat", feed="f", kind="latency", budget=0.1,
+                    percentile=0.9)
+    eng, clk, state = _manual_engine(obj, fast_window_s=2.0,
+                                     slow_window_s=2.0, hysteresis=2,
+                                     min_bad_events=0.0)
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 0), 0)
+    eng.evaluate()
+    # burn for ONE evaluation, then clean for the window: the pending
+    # degraded candidate must reset, never transition.
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 5), 5)
+    clk["t"] = 1.0
+    assert eng.evaluate()["state"] == HEALTHY
+    state["val"] = ("hist", BUCKETS, (100, 0, 0, 5), 105)
+    for t in (4.0, 5.0, 6.0):
+        clk["t"] = t
+        assert eng.evaluate()["state"] == HEALTHY
+    assert not eng.transitions
+
+
+def test_health_transition_instant_lands_in_trace():
+    TRACER.enable(ring=4)
+    TRACER.set_slot(7)
+    obj = Objective("lat", feed="f", kind="latency", budget=0.1,
+                    percentile=0.9)
+    eng, clk, state = _manual_engine(obj, fast_window_s=100.0,
+                                     slow_window_s=100.0, hysteresis=1,
+                                     min_bad_events=0.0)
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 0), 0)
+    eng.evaluate()
+    state["val"] = ("hist", BUCKETS, (0, 0, 0, 10), 10)
+    clk["t"] = 1.0
+    eng.evaluate()
+    trace = TRACER.slot_trace(7)
+    names = [s["name"] for s in trace["spans"]]
+    assert "health_transition" in names
+    inst = next(s for s in trace["spans"]
+                if s["name"] == "health_transition")
+    assert inst["attrs"]["to_state"] == DEGRADED
+    assert inst["attrs"]["reasons"] == "lat"
+
+
+def test_worst_slots_attribution_from_slot_stats():
+    import time as _time
+    TRACER.enable(ring=8)
+    obj = Objective("block_import", feed="f", kind="latency",
+                    budget=0.001, percentile=0.99,
+                    trace_cat="block_import")
+    eng, clk, state = _manual_engine(obj, fast_window_s=10.0,
+                                     slow_window_s=10.0)
+    with TRACER.span("block_import", cat="block_import", slot=11):
+        _time.sleep(0.01)  # > the 1 ms budget
+    state["val"] = ("hist", BUCKETS, (1, 0, 0, 0), 1)
+    row = eng.evaluate()["objectives"][0]
+    assert row["worst_slots"], row
+    assert row["worst_slots"][0]["slot"] == 11
+    assert row["worst_slots"][0]["trace"] == "/lighthouse/tracing/slot/11"
+    assert row["worst_slots"][0]["max_ms"] > 1.0
+
+
+def test_tracer_slot_stats_record_time_aggregates():
+    import time as _time
+    TRACER.enable(ring=4)
+    with TRACER.span("a", cat="x", slot=3):
+        _time.sleep(0.002)
+    with TRACER.span("b", cat="x", slot=3):
+        pass
+    TRACER.instant("i", cat="x", slot=3)  # instants don't enter stats
+    stats = {s["slot"]: s["stats"] for s in TRACER.slot_stats()}
+    st = stats[3]["x"]
+    assert st["count"] == 2
+    assert st["max_ms"] >= 2.0
+    assert st["total_ms"] >= st["max_ms"]
+
+
+def test_default_objectives_budgets_scale_with_slot():
+    objs = {o.name: o for o in default_objectives(12.0)}
+    assert abs(objs["gossip_to_verified"].budget - 4.0) < 1e-9
+    assert abs(objs["block_import"].budget - 0.150) < 1e-9
+    assert abs(objs["shed_rate"].budget - 0.001) < 1e-9
+    assert abs(objs["host_fallback_rate"].budget - 0.01) < 1e-9
+    assert objs["import_failure_rate"].severity == UNHEALTHY
+    compressed = {o.name: o for o in default_objectives(0.3)}
+    assert abs(compressed["gossip_to_verified"].budget - 0.1) < 1e-9
+
+
+def test_import_failure_counters_classify_errors(api_server):
+    h, chain, _srv = api_server
+    attempts0 = chain._slo_import_attempts
+    failures0 = chain._slo_import_failures
+    # A peer-protocol rejection (unknown parent) is NOT an
+    # infrastructure failure.
+    bad = h.build_block(slot=int(h.state.slot) + 2)
+    bad.message.parent_root = b"\x77" * 32
+    chain.per_slot_task(int(bad.message.slot))
+    import pytest as _pytest
+    from lighthouse_tpu.beacon_chain.errors import BlockError
+    with _pytest.raises(BlockError):
+        chain.process_block(bad)
+    # Protocol rejections touch NEITHER side of the rate (junk gossip
+    # must not dilute the denominator).
+    assert chain._slo_import_attempts == attempts0
+    assert chain._slo_import_failures == failures0
+    # An infrastructure error (store dying mid-import) IS one.
+    orig = chain.store.do_atomically
+    chain.store.do_atomically = lambda ops: (_ for _ in ()).throw(
+        RuntimeError("disk on fire"))
+    try:
+        good = h.build_block()
+        chain.per_slot_task(int(good.message.slot))
+        with _pytest.raises(RuntimeError):
+            chain.process_block(good)
+    finally:
+        chain.store.do_atomically = orig
+    assert chain._slo_import_failures == failures0 + 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP routes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def api_server():
+    from lighthouse_tpu.api.http_api import HttpApiServer
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls as B
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    B.set_backend("fake")
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=hdr.tree_hash_root(),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    srv = HttpApiServer(chain)
+    srv.start()
+    yield h, chain, srv
+    srv.stop()
+    B.set_backend("python")
+
+
+def _get(srv, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{srv.port}{path}")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health_route_empty_ring_200_healthy(api_server):
+    _h, _chain, srv = api_server
+    # Fresh node, tracer disabled, no traffic at all: 200 healthy.
+    code, body = _get(srv, "/lighthouse/health")
+    assert code == 200
+    assert body["data"]["state"] == HEALTHY
+    assert body["data"]["reasons"] == []
+
+
+def test_slo_route_reports_every_objective(api_server):
+    h, chain, srv = api_server
+    # The route's tick() honors the evaluation rate limit; let the
+    # request's own tick evaluate so it sees the import below.
+    chain.slo_engine.configure(min_eval_interval_s=0.0)
+    chain.per_slot_task(1)
+    signed = h.build_block(slot=1)
+    h.apply_block(signed)
+    chain.process_block(signed, is_timely=True)
+    code, body = _get(srv, "/lighthouse/slo")
+    assert code == 200
+    data = body["data"]
+    assert data["state"] == HEALTHY
+    names = {o["name"] for o in data["objectives"]}
+    assert names == {"gossip_to_verified", "block_import", "shed_rate",
+                     "import_failure_rate", "host_fallback_rate"}
+    rows = {o["name"]: o for o in data["objectives"]}
+    # the block import above fed the record-time histogram
+    assert rows["block_import"]["slow"]["events"] >= 1
+    assert rows["block_import"]["slow"]["attainment"] is not None
+    assert "fast_s" in data["windows"] and "slow_s" in data["windows"]
+
+
+def test_health_route_503_when_unhealthy(api_server):
+    _h, chain, srv = api_server
+    eng = chain.slo_engine
+    prev_state, prev_enabled = eng.state, eng.enabled
+    # Pin the state machine (enabled=False keeps the route's tick from
+    # re-evaluating it away): the route contract is status-code ←
+    # health state.
+    eng.enabled = False
+    eng.state = UNHEALTHY
+    eng._current_reasons = ["shed_rate"]
+    try:
+        code, body = _get(srv, "/lighthouse/health")
+        assert code == 503
+        assert body["data"]["state"] == UNHEALTHY
+        assert body["data"]["reasons"] == ["shed_rate"]
+    finally:
+        eng.state = prev_state
+        eng.enabled = prev_enabled
+        eng._current_reasons = []
+
+
+# ---------------------------------------------------------------------------
+# Satellites: process metrics + cache observability
+# ---------------------------------------------------------------------------
+
+def test_process_metrics_on_scrape():
+    text = M.REGISTRY.encode()
+    for family in ("process_resident_memory_bytes", "process_threads",
+                   "process_open_fds", "process_uptime_seconds"):
+        assert f"\n{family} " in text or text.startswith(f"{family} "), \
+            family
+    assert 'process_gc_collections{generation="0"}' in text
+    assert 'process_gc_collections{generation="2"}' in text
+
+
+def test_compile_cache_counters_exposed():
+    from lighthouse_tpu.common import compile_cache as CC
+    assert CC.install_monitoring()  # idempotent; registers the listener
+    before = M.REGISTRY.counter(
+        "compile_cache_events_total", "",
+        labelnames=("event",)).labels("hit").value
+    CC._on_jax_event("/jax/compilation_cache/cache_hits")
+    CC._on_jax_event("/jax/compilation_cache/compile_requests_use_cache")
+    CC._on_jax_event("/jax/unrelated/event")
+    fam = M.REGISTRY.counter("compile_cache_events_total", "",
+                             labelnames=("event",))
+    assert fam.labels("hit").value == before + 1
+    text = M.REGISTRY.encode()
+    assert 'compile_cache_events_total{event="hit"}' in text
+    assert "compile_cache_misses" in text
+
+
+def test_shuffle_cache_hit_miss_counters():
+    from lighthouse_tpu.state_transition.committees import (
+        get_committee_cache)
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    fam = M.REGISTRY.counter("shuffle_cache_requests_total", "",
+                             labelnames=("outcome",))
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    misses0 = fam.labels("miss").value
+    hits0 = fam.labels("hit").value
+    get_committee_cache(h.state, 0, h.preset)   # first build: miss
+    get_committee_cache(h.state, 0, h.preset)   # cached: hit
+    get_committee_cache(h.state, 0, h.preset)   # cached: hit
+    assert fam.labels("miss").value >= misses0 + 1
+    assert fam.labels("hit").value >= hits0 + 2
+
+
+def test_slo_knobs_declared():
+    from lighthouse_tpu.common.knobs import KNOBS
+    for name in ("LIGHTHOUSE_TPU_SLO", "LIGHTHOUSE_TPU_SLO_FAST_WINDOW_S",
+                 "LIGHTHOUSE_TPU_SLO_SLOW_WINDOW_S",
+                 "LIGHTHOUSE_TPU_SLO_BLOCK_IMPORT_MS",
+                 "LIGHTHOUSE_TPU_SLO_SHED_PCT",
+                 "LIGHTHOUSE_TPU_SLO_FALLBACK_PCT",
+                 "LIGHTHOUSE_TPU_SLO_HYSTERESIS"):
+        assert name in KNOBS, name
+
+
+# ---------------------------------------------------------------------------
+# Sustained drill, quick size (compressed time, fake backend)
+# ---------------------------------------------------------------------------
+
+def test_sustained_drill_zero_loss_and_attainment():
+    from lighthouse_tpu.testing.sustained_load import run_sustained
+
+    board = run_sustained(slots=8, slot_s=0.3, n_validators=64, seed=0)
+    assert board["loss"]["zero_loss"], board["loss"]
+    assert not board["loss"]["drain_timeouts"]
+    assert board["messages"]["submitted"] > 0
+    assert board["messages"]["verified"] == board["messages"]["submitted"]
+    assert board["attainment_complete"], board["attainment"]
+    # compressed-time noise may transiently degrade; it must never go
+    # unhealthy and must END healthy
+    assert board["health"]["state"] == HEALTHY
+    assert not any(t["to"] == UNHEALTHY
+                   for t in board["health"]["transitions"])
+    # the scoreboard carries the trace ring's slot summaries
+    assert board["trace_slots"]
+    # every measured slot evaluated health
+    assert len(board["per_slot"]) == 8
+
+
+def test_sustained_drill_fault_outage_attributed():
+    from lighthouse_tpu.testing.sustained_load import run_sustained
+
+    board = run_sustained(slots=12, slot_s=0.35, n_validators=64,
+                          seed=1, faults_outage_slots=(4, 7))
+    assert board["loss"]["zero_loss"], board["loss"]
+    attr = board["fault_attribution"]
+    assert attr["injected"] > 0
+    assert board["host_fallbacks"] > 0      # the outage was carried
+    assert attr["went_degraded"], board["health"]["transitions"]
+    assert attr["recovered_healthy"]
+    assert attr["attributed"], attr
+    assert board["breaker"]["state"] == "closed"
