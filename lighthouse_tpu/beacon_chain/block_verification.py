@@ -128,8 +128,16 @@ class ExecutedBlock:
         """`ExecutionPendingBlock::from_signature_verified_components`
         (`block_verification.rs:1104`): one transition with ``VERIFY_BULK``
         (non-proposal signatures batched into one device verify during
-        execution), then the post-state root check (`:1423`)."""
-        from ..state_transition.per_block import BlockProcessingError
+        execution), then the post-state root check (`:1423`).
+
+        The transition runs with ``defer_sig_join=True``: under the
+        overlapped pipeline the signature batch dispatched to the device
+        before the participation/rewards phase, and its verdict JOINS
+        here — after the post-state-root hash, right before the root
+        check — so device pairing time hides behind host transition +
+        hashing compute."""
+        from ..state_transition.per_block import (
+            BlockProcessingError, InvalidSignaturesError)
         from ..ssz.core import SszError
 
         block = sv.signed_block.message
@@ -144,21 +152,39 @@ class ExecutedBlock:
             with TRACER.span("state_transition", cat="state_transition",
                              slot=int(block.slot)) as _sp:
                 _mark = TRACER.residency_mark()
-                process_block(state, sv.signed_block, fork, chain.preset,
-                              chain.spec, chain.T,
-                              strategy=SignatureStrategy.VERIFY_BULK,
-                              pubkey_cache=chain.pubkey_cache,
-                              payload_verifier=chain.payload_verifier)
+                pending = process_block(
+                    state, sv.signed_block, fork, chain.preset,
+                    chain.spec, chain.T,
+                    strategy=SignatureStrategy.VERIFY_BULK,
+                    pubkey_cache=chain.pubkey_cache,
+                    payload_verifier=chain.payload_verifier,
+                    defer_sig_join=True)
                 TRACER.record_residency(_sp, _mark)
+        except InvalidSignaturesError as e:
+            # TYPED classification: only an actual cryptographic verdict
+            # (or a signature/key codec failure below) is
+            # InvalidSignatures — a non-signature rejection whose
+            # message mentions "signature" stays InvalidBlock (the old
+            # string matcher got this wrong in both directions).
+            raise InvalidSignatures(str(e)) from e
+        except bls.BlsError as e:
+            # Malformed / out-of-subgroup signature or pubkey encodings
+            # in the block body fail at deserialization — signature
+            # rejections too.
+            raise InvalidSignatures(str(e)) from e
         except (BlockProcessingError, SszError, ValueError) as e:
-            # Signature batch failures are InvalidSignatures; every other
-            # transition rejection keeps its own label.  Programming errors
-            # (TypeError/AttributeError/...) propagate unwrapped.
-            if "signature" in str(e).lower():
-                raise InvalidSignatures(str(e)) from e
+            # Every other transition rejection keeps its own label.
+            # Programming errors (TypeError/AttributeError/...)
+            # propagate unwrapped.
             raise InvalidBlock(str(e)) from e
         with TRACER.span("post_state_root", cat="state_transition"):
             root = state.tree_hash_root()
+        # JOIN the overlapped signature batch before the root CHECK —
+        # the signature verdict outranks the root comparison.
+        try:
+            pending.finish()
+        except InvalidSignaturesError as e:
+            raise InvalidSignatures(str(e)) from e
         if root != bytes(block.state_root):
             raise StateRootMismatch(
                 f"{root.hex()} != {bytes(block.state_root).hex()}")

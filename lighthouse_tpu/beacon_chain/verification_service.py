@@ -1107,6 +1107,22 @@ def _global_dispatch(backend, sets):
     return bool(ok)
 
 
+def block_sig_dispatch(device_fn, sets) -> tuple:
+    """Envelope-wrapped dispatch for the OVERLAPPED block-signature
+    batch (``state_transition.sig_dispatch``): shares the global BLS
+    envelope — and therefore its circuit breaker — with every other
+    non-streamed verify, so a device outage degrades block batches to
+    the host oracle through the SAME machinery (zero new failure modes)
+    and bench's breaker attribution sees the block path too.  Returns
+    ``(verdict, path)``."""
+    from ..crypto import bls
+    env = global_bls_envelope()
+    ok, path = env.call(device_fn,
+                        bls._BACKENDS["python"].verify_signature_sets,
+                        (sets,))
+    return bool(ok), path
+
+
 def install_global_envelope() -> bool:
     """Route module-level ``bls.verify_signature_sets`` through the
     global envelope (idempotent; ``LIGHTHOUSE_TPU_RESILIENT=0``

@@ -99,6 +99,13 @@ _declare("LIGHTHOUSE_TPU_HOST_FASTPATH_MAX", "int", 4,
          "pairing; 0 keeps everything on-device.", min_value=0)
 
 # -- state transition --
+_declare("LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS", "bool", True,
+         "Overlapped block import: dispatch the block's signature batch "
+         "asynchronously before the participation/rewards phase (0 = "
+         "trailing synchronous verify, the oracle).")
+_declare("LIGHTHOUSE_TPU_BLOCK_SIG_SHARD", "tribool", "auto",
+         "Route block signature batches through the mesh-sharded BLS "
+         "path (auto: on iff the TPU backend runs on >1 device).")
 _declare("LIGHTHOUSE_TPU_BATCHED_ATTS", "bool", True,
          "Columnar batched attestation processing (0 = scalar spec "
          "oracle).")

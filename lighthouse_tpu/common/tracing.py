@@ -548,6 +548,11 @@ def _src_materialize() -> dict:
     return LAST_MATERIALIZE_STATS
 
 
+def _src_block_sigs() -> dict:
+    from ..state_transition.sig_dispatch import LAST_SIG_DISPATCH
+    return LAST_SIG_DISPATCH
+
+
 _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "block": _src_block,
     "epoch": _src_epoch,
@@ -559,6 +564,7 @@ _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "residency": _src_residency,
     "pipeline": _src_pipeline,
     "materialize": _src_materialize,
+    "block_sigs": _src_block_sigs,
 }
 
 

@@ -51,7 +51,14 @@ def _g1_point_checked(data: bytes):
     """Decompress + subgroup-check a G1 pubkey encoding, memoized by bytes —
     the decompressed-pubkey cache role of ``validator_pubkey_cache.rs``
     pushed down to the codec (pure function of the encoding)."""
-    point = C.g1_decompress(data)
+    try:
+        point = C.g1_decompress(data)
+    except ValueError as e:
+        # Curve-codec rejections ("x not on curve", bad flags, x >= p)
+        # are key-material failures: surface them as BlsError so callers
+        # classifying signature-material errors (block import's
+        # InvalidSignatures boundary) see one type.
+        raise BlsError(str(e)) from e
     if point is None:
         raise BlsError("infinity public key is invalid")
     if not C.g1_subgroup_check(point):
@@ -71,7 +78,10 @@ def _g2_mul_fast(point, scalar: int):
 
 @lru_cache(maxsize=1 << 16)
 def _g2_point_checked(data: bytes):
-    point = C.g2_decompress(data)
+    try:
+        point = C.g2_decompress(data)
+    except ValueError as e:
+        raise BlsError(str(e)) from e
     if point is not None and not C.g2_subgroup_check(point):
         raise BlsError("signature not in the G2 subgroup")
     return point
@@ -195,6 +205,37 @@ class SignatureSet:
     signature: Optional[Signature]
     signing_keys: List[PublicKey]
     message: bytes
+
+
+def signature_set_key(s: SignatureSet) -> tuple:
+    """Exact-identity key of a set: (message, signature point, signing
+    key points).  Two sets with equal keys verify identically under any
+    backend."""
+    return (bytes(s.message),
+            None if s.signature is None else s.signature.point,
+            tuple(k.point for k in s.signing_keys))
+
+
+def dedup_signature_sets(sets: Sequence[SignatureSet]
+                         ) -> tuple[List[SignatureSet], int]:
+    """Drop exact-duplicate sets (same message, keys AND signature)
+    before a batch dispatch; returns ``(unique_sets, dropped)``.
+
+    Verdict-identical by construction: the RLC batch verifies iff every
+    DISTINCT set verifies (duplicates contribute redundant random-linear
+    terms), and the empty/invalid-set pre-checks see at least one copy
+    of each distinct set.  A block's batch hits this when the proposer
+    packs the same committee aggregate twice (allowed by spec) or an
+    attester-slashing attestation repeats an included attestation."""
+    seen: set = set()
+    out: List[SignatureSet] = []
+    for s in sets:
+        key = signature_set_key(s)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(s)
+    return out, len(sets) - len(out)
 
 
 # ---------------------------------------------------------------------------

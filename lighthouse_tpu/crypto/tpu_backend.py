@@ -789,13 +789,26 @@ def _dispatch(entries, rand_fn) -> bool:
         return _dispatch_shared(entries, shared_pt, rand_fn)
     if _use_pallas():
         return _dispatch_pallas(entries, rand_fn)
-    sub = _pipeline_sets()
-    if sub > 0 and len(entries) > sub \
-            and all(e[0] is not None for e in entries):
+    # Off-TPU XLA path: the SAME K-grouped work list as the Pallas path
+    # (`_split_batches`) — a mixed-width batch (the overlapped block
+    # batch: ~committee-width attestation sets + single-key proposer/
+    # randao/exit sets + a 512-key sync aggregate) no longer pads every
+    # set's pubkey lanes to the batch max K.  Each work item is an
+    # independent RLC product, so the AND of verdicts equals the
+    # monolithic verdict.
+    work = _split_batches(entries)
+    if len(work) > 1:
+        if _pipeline_sets() <= 0:
+            # PIPELINE_SETS=0 disables the staged machinery (the
+            # debugging oracle): K-groups dispatch sequentially, one
+            # monolithic marshal + kernel each.
+            return all(
+                bool(_verify_sets_kernel(*_marshal_xla(batch, rand_fn)))
+                for batch in work)
         from ..parallel.pipeline import StagedExecutor
         ex = StagedExecutor("bls_pipeline")
         outs = ex.map(
-            [entries[j:j + sub] for j in range(0, len(entries), sub)],
+            work,
             lambda batch: _marshal_xla(batch, rand_fn),
             lambda staged: _verify_sets_kernel(*staged))
         return all(bool(o) for o in outs)

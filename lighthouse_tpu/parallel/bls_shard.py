@@ -201,3 +201,31 @@ def sharded_verify_signature_sets(sets, mesh, rand_fn=None) -> bool:
         scal = _pad_rows(scal, S_pad, np.zeros((1, 2), np.uint32))
         smask = _pad_rows(smask, S_pad, np.zeros(1, bool))
     return bool(_sharded_verify_fn(mesh)(pk, kmask, sig, h, scal, smask))
+
+
+def bucketed_verify_signature_sets(sets, mesh, rand_fn=None) -> bool:
+    """Sharded batch verify with verification-service-style K-buckets —
+    the block-batch entry point of the overlapped signature pipeline.
+
+    :func:`sharded_verify_signature_sets` pads every set's key list to
+    the batch-wide max K.  A block's batch mixes committee-width
+    attestation sets with single-key proposer/randao/exit sets and a
+    possible 512-key sync aggregate, so one monolithic pad wastes most
+    of the pubkey-aggregation lanes; here sets group by padded signer
+    count (next_pow2 — the same bucket key the verification service
+    uses at ingress) and each bucket dispatches as its own sharded
+    batch.  Buckets are independent RLC products, so the AND of bucket
+    verdicts equals the monolithic verdict (a failing bucket
+    short-circuits, exactly like a failing monolithic batch returns
+    one False)."""
+    if not sets:
+        return False
+    groups: dict = {}
+    for s in sets:
+        k = _next_pow2(max(1, len(getattr(s, "signing_keys", ()) or ())))
+        groups.setdefault(k, []).append(s)
+    for k in sorted(groups):
+        if not sharded_verify_signature_sets(groups[k], mesh,
+                                             rand_fn=rand_fn):
+            return False
+    return True

@@ -44,6 +44,46 @@ class PubkeyCache:
             self._index_by_pubkey[raw] = index
         return pk
 
+    def get_many(self, registry, indices) -> list[PublicKey]:
+        """Batched decompress-and-cache: the committee-sized builders'
+        replacement for per-index :meth:`get` loops (one pubkey-column
+        gather + one dict sweep instead of a Python attribute/method hop
+        per index — committee-sized per-index loops were measurable
+        block time).  Returns the keys in ``indices`` order."""
+        by_index = self._by_index
+        missing = {int(i) for i in indices if int(i) not in by_index}
+        if missing:
+            col = registry.col("pubkey")
+            for i in missing:
+                raw = col[i].tobytes()
+                pk = PublicKey.deserialize(raw)
+                by_index[i] = pk
+                self._index_by_pubkey[raw] = i
+        return [by_index[int(i)] for i in indices]
+
+    def get_many_bytes(self, registry, raws) -> list[PublicKey]:
+        """Batched lookup by compressed ENCODING (the sync-committee
+        shape: the state stores committee pubkeys as bytes, possibly
+        with duplicates, possibly — in hand-crafted states — not in the
+        registry at all).  Registry members route through the index
+        cache; foreign keys fall back to direct deserialization."""
+        out = []
+        for raw in raws:
+            raw = bytes(raw)
+            idx = self._index_by_pubkey.get(raw)
+            if idx is None:
+                idx = registry.pubkey_index(raw)
+                if idx is not None:
+                    self._index_by_pubkey[raw] = idx
+            if idx is None:
+                out.append(PublicKey.deserialize(raw))
+                continue
+            pk = self._by_index.get(idx)
+            if pk is None:
+                pk = self._by_index[idx] = PublicKey.deserialize(raw)
+            out.append(pk)
+        return out
+
     def index_of(self, registry, pubkey: bytes) -> int | None:
         idx = self._index_by_pubkey.get(pubkey)
         if idx is not None:
@@ -56,6 +96,39 @@ class PubkeyCache:
             return None
         self._index_by_pubkey[pubkey] = idx
         return idx
+
+
+class AttestationSigningRoots:
+    """Per-block memo of attestation signing material: the
+    ``BEACON_ATTESTER`` domain per target epoch (a block spans at most
+    two) and the signing root per ``AttestationData`` VALUE — duplicate
+    committee aggregates in one block share the data, and every
+    signing-root recompute is ~7 SHA rounds of SSZ hashing the memo
+    skips."""
+
+    def __init__(self, state, preset):
+        self._state = state
+        self._preset = preset
+        self._domains: dict[int, bytes] = {}
+        self._messages: dict[tuple, bytes] = {}
+
+    def domain(self, epoch: int) -> bytes:
+        d = self._domains.get(epoch)
+        if d is None:
+            d = self._domains[epoch] = get_domain(
+                self._state, Domain.BEACON_ATTESTER, epoch, self._preset)
+        return d
+
+    def message(self, data) -> bytes:
+        key = (int(data.slot), int(data.index),
+               bytes(data.beacon_block_root),
+               int(data.source.epoch), bytes(data.source.root),
+               int(data.target.epoch), bytes(data.target.root))
+        m = self._messages.get(key)
+        if m is None:
+            m = self._messages[key] = compute_signing_root(
+                data, self.domain(int(data.target.epoch)))
+        return m
 
 
 def block_proposal_signature_set(state, signed_block, pubkey_cache, preset,
@@ -98,14 +171,20 @@ def block_header_signature_set(state, signed_header, pubkey_cache,
 
 
 def indexed_attestation_signature_set(state, indices, signature_bytes, data,
-                                      pubkey_cache, preset) -> SignatureSet:
-    domain = get_domain(state, Domain.BEACON_ATTESTER, data.target.epoch,
-                        preset)
-    keys = [pubkey_cache.get(state.validators, int(i)) for i in indices]
+                                      pubkey_cache, preset,
+                                      msg_cache: AttestationSigningRoots
+                                      | None = None) -> SignatureSet:
+    if msg_cache is not None:
+        message = msg_cache.message(data)
+    else:
+        domain = get_domain(state, Domain.BEACON_ATTESTER, data.target.epoch,
+                            preset)
+        message = compute_signing_root(data, domain)
+    keys = pubkey_cache.get_many(state.validators, indices)
     return SignatureSet(
         signature=Signature.deserialize(signature_bytes),
         signing_keys=keys,
-        message=compute_signing_root(data, domain))
+        message=message)
 
 
 def attestation_signature_set(state, attestation, pubkey_cache,
@@ -129,11 +208,17 @@ def voluntary_exit_signature_set(state, signed_exit, pubkey_cache,
 
 
 def sync_aggregate_signature_set(state, sync_aggregate, slot: int,
-                                 block_root_fn, preset) -> SignatureSet | None:
+                                 block_root_fn, preset,
+                                 pubkey_cache: PubkeyCache | None = None,
+                                 ) -> SignatureSet | None:
     """Signature over the previous slot's block root by the participating
     sync-committee subset.  ``block_root_fn(slot)`` supplies the root
     (``sync_committee_verification``-style).  Returns None when no bits are
-    set and the signature is infinity (valid empty aggregate)."""
+    set and the signature is infinity (valid empty aggregate).
+
+    With a ``pubkey_cache`` the committee subset materializes through
+    one :meth:`PubkeyCache.get_many_bytes` sweep instead of a per-bit
+    deserialize loop."""
     bits = np.asarray(sync_aggregate.sync_committee_bits, dtype=bool)
     sig = Signature.deserialize(sync_aggregate.sync_committee_signature)
     if not bits.any():
@@ -144,8 +229,13 @@ def sync_aggregate_signature_set(state, sync_aggregate, slot: int,
     domain = get_domain(state, Domain.SYNC_COMMITTEE,
                         compute_epoch_at_slot(previous_slot,
                                               preset.SLOTS_PER_EPOCH), preset)
-    pubkeys = [PublicKey.deserialize(state.current_sync_committee.pubkeys[i])
-               for i in np.flatnonzero(bits)]
+    committee = state.current_sync_committee.pubkeys
+    sel = np.flatnonzero(bits)
+    if pubkey_cache is not None:
+        pubkeys = pubkey_cache.get_many_bytes(
+            state.validators, [committee[i] for i in sel])
+    else:
+        pubkeys = [PublicKey.deserialize(committee[i]) for i in sel]
     return SignatureSet(
         signature=sig,
         signing_keys=pubkeys,

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Differential validation of the overlapped block-signature pipeline
+(ISSUE 14): the asynchronously-dispatched batch must be VERDICT-
+IDENTICAL to the trailing synchronous verify on every block shape.
+
+    JAX_PLATFORMS=cpu python scripts/validate_block_sigs.py \
+        --atts 4 --seeds 3 [--device] [--warmup] \
+        [--trace trace.json --modeled-rate 1964.9]
+
+Per seed, a real-signed MINIMAL-preset block (committee attestations +
+sync aggregate) is run through ``process_block`` with the overlap knob
+ON and OFF, in four variants — valid, tampered nth-attestation
+signature, tampered randao reveal, empty-ops — plus a NO_VERIFICATION
+control (the tampered block must pass under both paths: no phantom
+dispatch).  Outcomes compare as ("ok", post-state root) /
+("err", error class); **any divergence exits 1**.
+
+``--device`` keeps the configured BLS backend (the TPU path; otherwise
+the python host oracle verifies, real pairings).  ``--warmup``
+pre-compiles the K-bucketed dispatch shapes a block batch produces into
+``.jax_cache`` (minutes per shape cold on CPU — run once after kernel
+changes).  Compile-cache flags: the cache only replays for processes
+with matching XLA flags (see tests/conftest.py).
+
+``--trace FILE`` additionally drives one overlapped import with slot
+tracing enabled and a MODELED device (a sleep at ``--modeled-rate``
+sets/s, default the r5 measured flagship 1964.9 — the sleep releases
+the GIL, so the overlap is real), writes the Chrome trace-event JSON
+(open in Perfetto: the ``sig_dispatch`` span precedes the deferred
+participation/rewards apply, ``sig_join`` trails the post-state root),
+and prints the join-wait / device-verify split.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+FLAGSHIP_RATE_SETS_PER_S = 1964.9  # measured r5 flagship (BENCH r5)
+
+
+def _build_fixture(n_validators: int, n_atts: int):
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    h = StateHarness(n_validators=n_validators, preset=MINIMAL)
+    for _ in range(3):
+        h.apply_block(h.build_block())
+    sb = h.build_block()
+    atts = list(sb.message.body.attestations)[:max(1, n_atts)]
+    if len(list(sb.message.body.attestations)) != len(atts):
+        sb = h.build_block(attestations=atts)
+    return h, h.state.copy(), sb
+
+
+def _resign(h, block):
+    from lighthouse_tpu.state_transition import interop_secret_key
+    from lighthouse_tpu.state_transition.helpers import (
+        compute_signing_root, get_domain)
+    from lighthouse_tpu.types.chain_spec import Domain
+
+    epoch = int(block.slot) // h.preset.SLOTS_PER_EPOCH
+    domain = get_domain(h.state, Domain.BEACON_PROPOSER, epoch, h.preset)
+    sig = interop_secret_key(int(block.proposer_index)).sign(
+        compute_signing_root(block, domain)).serialize()
+    return h.T.signed_block_cls(
+        h.fork_at(int(block.slot)))(message=block, signature=sig)
+
+
+def _run(h, pre, sb, strategy=None, dispatcher=None):
+    from lighthouse_tpu.state_transition import SignatureStrategy
+    from lighthouse_tpu.state_transition.per_block import (
+        BlockProcessingError, process_block)
+    from lighthouse_tpu.state_transition.per_slot import process_slots
+
+    if strategy is None:
+        strategy = SignatureStrategy.VERIFY_BULK
+    state = pre.copy()
+    state = process_slots(state, int(sb.message.slot), h.preset, h.spec,
+                          h.T)
+    try:
+        acc = process_block(state, sb, h.fork_at(int(sb.message.slot)),
+                            h.preset, h.spec, h.T, strategy=strategy,
+                            sig_dispatcher=dispatcher,
+                            defer_sig_join=True)
+        root = state.tree_hash_root()  # the import-path overlap window
+        if acc is not None:
+            acc.finish()
+    except BlockProcessingError as e:
+        return ("err", type(e).__name__)
+    return ("ok", root.hex())
+
+
+def _variants(h, sb, rng):
+    from lighthouse_tpu.state_transition import interop_secret_key
+
+    out = [("valid", sb)]
+    atts = list(sb.message.body.attestations)
+    if atts:
+        n = rng.randrange(len(atts))
+        block = sb.message.copy()
+        block.body.attestations[n].signature = interop_secret_key(0).sign(
+            b"tampered-%d" % n).serialize()
+        out.append((f"tampered_att_{n}", _resign(h, block)))
+    block = sb.message.copy()
+    block.body.randao_reveal = interop_secret_key(
+        int(block.proposer_index)).sign(b"wrong-epoch").serialize()
+    out.append(("tampered_randao", _resign(h, block)))
+    out.append(("empty_ops",
+                h.build_block(attestations=[], sync_participation=0.0)))
+    return out
+
+
+def _with_knob(value: str):
+    os.environ["LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS"] = value
+
+
+def _warmup(h, pre, sb) -> None:
+    """Pre-compile the K-bucketed dispatch shapes of this block batch
+    (one overlapped run on the CONFIGURED backend — its jit programs
+    persist into .jax_cache)."""
+    t0 = time.perf_counter()
+    _with_knob("1")
+    out = _run(h, pre, sb)
+    print(f"warmup: overlapped dispatch ran ({out[0]}) in "
+          f"{time.perf_counter() - t0:.1f}s — shapes persisted")
+
+
+def _trace_run(h, pre, sb, out_path: str, rate: float) -> dict:
+    from lighthouse_tpu.common.tracing import TRACER
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu.state_transition.sig_dispatch import (
+        BlockSigDispatcher)
+
+    def modeled_device(sets):
+        time.sleep(len(sets) / rate)   # releases the GIL — real overlap
+        return True
+
+    disp = BlockSigDispatcher(device_fn=modeled_device,
+                              host_fn=modeled_device,
+                              name="block_sigs_modeled")
+    _with_knob("1")
+    _run(h, pre, sb, dispatcher=disp)  # warm the dispatcher/envelope
+    was_enabled = TRACER.enabled
+    try:
+        if not was_enabled:
+            TRACER.reset()
+        TRACER.enable()
+        slot = int(sb.message.slot)
+        TRACER.set_slot(slot)
+        # An import-shaped enclosing span: the per-phase stage children
+        # and the sig spans assemble into ONE slot trace, like the real
+        # chain import path.
+        with TRACER.span("block_import", cat="block_import", slot=slot):
+            verdict = _run(h, pre, sb, dispatcher=disp)
+        chrome = TRACER.chrome_trace(slot)
+    finally:
+        if was_enabled:
+            TRACER.enable()
+        else:
+            TRACER.disable()
+            TRACER.reset()
+    split = tracing.stage_split("block_sigs")
+    block_split = tracing.stage_split("block")
+    stats = {
+        "verdict": verdict[0],
+        "sets": split.get("sets"),
+        "deduped": split.get("deduped"),
+        "path": split.get("path"),
+        "device_verify_ms": split.get("device_verify_ms"),
+        "join_wait_ms": split.get("join_wait_ms"),
+        "overlap_efficiency": split.get("overlap_efficiency"),
+        "dispatched_before_apply": ("sig_dispatch_ms" in block_split
+                                    and "deferred_apply_ms" in block_split),
+        "modeled_rate_sets_per_s": rate,
+    }
+    if out_path and chrome is not None:
+        with open(out_path, "w") as f:
+            json.dump(chrome, f)
+        print(f"chrome trace written to {out_path} "
+              f"({len(chrome['traceEvents'])} events) — open in Perfetto")
+    return stats
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--atts", type=int, default=4)
+    ap.add_argument("--validators", type=int, default=32)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--device", action="store_true",
+                    help="keep the configured BLS backend (device path); "
+                         "default forces the python host oracle")
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile the block batch's K-bucketed "
+                         "dispatch shapes before validating")
+    ap.add_argument("--trace", type=str, default=None,
+                    help="write a Chrome trace of one modeled-device "
+                         "overlapped import here")
+    ap.add_argument("--modeled-rate", type=float,
+                    default=FLAGSHIP_RATE_SETS_PER_S,
+                    help="modeled device verify rate (sets/s) for "
+                         "--trace")
+    args = ap.parse_args()
+
+    import random
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_transition import SignatureStrategy
+
+    if not args.device:
+        bls.set_backend("python")
+
+    failures = 0
+    prev = os.environ.pop("LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS", None)
+    try:
+        h, pre, sb = _build_fixture(args.validators, args.atts)
+        if args.warmup:
+            _warmup(h, pre, sb)
+        for seed in range(args.seeds):
+            rng = random.Random(0xB10C + seed)
+            for name, variant in _variants(h, sb, rng):
+                _with_knob("1")
+                got_overlap = _run(h, pre, variant)
+                _with_knob("0")
+                got_sync = _run(h, pre, variant)
+                agree = got_overlap == got_sync
+                print(f"seed {seed} {name:<18} overlap={got_overlap} "
+                      f"sync={got_sync} {'OK' if agree else 'DIVERGED'}")
+                if not agree:
+                    failures += 1
+                if name.startswith("tampered"):
+                    for mode in ("1", "0"):
+                        _with_knob(mode)
+                        ctl = _run(h, pre, variant,
+                                   strategy=SignatureStrategy.
+                                   NO_VERIFICATION)
+                        if ctl[0] != "ok":
+                            print(f"  NO_VERIFICATION control broke "
+                                  f"(knob={mode}): {ctl}")
+                            failures += 1
+        if args.trace:
+            stats = _trace_run(h, pre, sb, args.trace, args.modeled_rate)
+            print("modeled-device overlap: "
+                  + json.dumps(stats, default=str))
+    finally:
+        if prev is None:
+            os.environ.pop("LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS"] = prev
+
+    if failures:
+        print(f"FAIL: {failures} divergence(s)", file=sys.stderr)
+        return 1
+    print("all variants verdict-identical (overlapped == synchronous)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

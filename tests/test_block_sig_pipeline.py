@@ -1,0 +1,543 @@
+"""Overlapped block-signature pipeline (ISSUE 14): differential suite,
+breaker drill, typed error classification, and the satellite units.
+
+Everything here is quick-tier: real-crypto differentials run on the
+python backend over tiny MINIMAL harnesses (a handful of pairing lanes
+per verify), the machinery drills run on the fake backend or injected
+verify fns — no jitted pairing-shaped program is ever compiled.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.state_transition import (
+    SignatureStrategy,
+    interop_secret_key,
+)
+from lighthouse_tpu.state_transition import signature_sets as sigs
+from lighthouse_tpu.state_transition import sig_dispatch as SD
+from lighthouse_tpu.state_transition.helpers import (
+    compute_signing_root,
+    get_domain,
+)
+from lighthouse_tpu.state_transition.per_block import (
+    BlockProcessingError,
+    InvalidSignaturesError,
+    process_block,
+)
+from lighthouse_tpu.state_transition.per_slot import process_slots
+from lighthouse_tpu.common import tracing
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import Domain
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@contextmanager
+def overlap_knob(enabled: bool):
+    prev = os.environ.pop("LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS", None)
+    os.environ["LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS"] = \
+        "1" if enabled else "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_OVERLAP_BLOCK_SIGS"] = prev
+
+
+@pytest.fixture()
+def pybls():
+    prev = next(k for k, v in B._BACKENDS.items() if v is B.get_backend())
+    B.set_backend("python")
+    yield
+    B.set_backend(prev)
+
+
+@pytest.fixture()
+def fakebls():
+    prev = next(k for k, v in B._BACKENDS.items() if v is B.get_backend())
+    B.set_backend("fake")
+    yield
+    B.set_backend(prev)
+
+
+# Shared harness: a short real-signed chain whose next block carries
+# attestations + a sync aggregate — built once (real signing is the
+# expensive part), every test runs on copies.
+_HFX: dict = {}
+
+
+def _harness_fixture() -> dict:
+    if not _HFX:
+        h = StateHarness(n_validators=32, preset=MINIMAL)
+        for _ in range(3):
+            h.apply_block(h.build_block())
+        sb = h.build_block()
+        assert len(sb.message.body.attestations) >= 1
+        _HFX.update(h=h, pre=h.state.copy(), signed=sb)
+    return _HFX
+
+
+def _resign(h, block):
+    """Proposer-re-sign ``block`` (tampering the body invalidates the
+    proposal signature; re-signing isolates the tampered leg)."""
+    epoch = int(block.slot) // h.preset.SLOTS_PER_EPOCH
+    domain = get_domain(h.state, Domain.BEACON_PROPOSER, epoch, h.preset)
+    sig = interop_secret_key(int(block.proposer_index)).sign(
+        compute_signing_root(block, domain)).serialize()
+    return h.T.signed_block_cls(
+        h.fork_at(int(block.slot)))(message=block, signature=sig)
+
+
+def _run(h, pre, sb, strategy=SignatureStrategy.VERIFY_BULK,
+         dispatcher=None):
+    """Apply ``sb`` to a copy of ``pre``; returns ("ok", post_root) or
+    ("err", error-class-name)."""
+    state = pre.copy()
+    state = process_slots(state, int(sb.message.slot), h.preset, h.spec,
+                          h.T)
+    try:
+        process_block(state, sb, h.fork_at(int(sb.message.slot)),
+                      h.preset, h.spec, h.T, strategy=strategy,
+                      sig_dispatcher=dispatcher)
+    except BlockProcessingError as e:
+        return ("err", type(e).__name__)
+    return ("ok", state.tree_hash_root())
+
+
+def _differential(sb, expect):
+    """Run ``sb`` with the overlapped pipeline and the synchronous
+    oracle; both must agree (and match ``expect`` when given)."""
+    fx = _harness_fixture()
+    with overlap_knob(True):
+        got_overlap = _run(fx["h"], fx["pre"], sb)
+    with overlap_knob(False):
+        got_sync = _run(fx["h"], fx["pre"], sb)
+    assert got_overlap == got_sync
+    if expect is not None:
+        assert got_overlap[0] == expect[0]
+        if expect[0] == "err":
+            assert got_overlap[1] == expect[1]
+    return got_overlap
+
+
+# ---------------------------------------------------------------------------
+# Differential suite (python backend — real pairings, tiny batches)
+# ---------------------------------------------------------------------------
+
+
+def test_valid_block_verdict_identical(pybls):
+    fx = _harness_fixture()
+    out = _differential(fx["signed"], ("ok", None))
+    assert out[0] == "ok"
+    # The overlapped run's stats surfaced through the stage adapter.
+    split = tracing.stage_split("block_sigs")
+    assert split["path"] == "sync"  # last run above was the oracle
+    with overlap_knob(True):
+        _run(fx["h"], fx["pre"], fx["signed"])
+    split = tracing.stage_split("block_sigs")
+    assert split["overlapped"] is True
+    assert split["sets"] >= 3  # proposal + randao + attestations
+    assert split["join_wait_ms"] >= 0.0
+    assert split["device_verify_ms"] > 0.0
+
+
+def test_tampered_nth_attestation_rejects_both_paths(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    sb = fx["signed"]
+    block = sb.message.copy()
+    n = len(block.body.attestations) - 1
+    block.body.attestations[n].signature = interop_secret_key(0).sign(
+        b"wrong message").serialize()
+    tampered = _resign(h, block)
+    _differential(tampered, ("err", "InvalidSignaturesError"))
+
+
+def test_tampered_randao_rejects_both_paths(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    block = fx["signed"].message.copy()
+    block.body.randao_reveal = interop_secret_key(
+        int(block.proposer_index)).sign(b"wrong epoch").serialize()
+    _differential(_resign(h, block), ("err", "InvalidSignaturesError"))
+
+
+def test_empty_ops_block_verdict_identical(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    sb = h.build_block(attestations=[], sync_participation=0.0)
+    _differential(sb, ("ok", None))
+
+
+def test_no_verification_never_dispatches(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    block = fx["signed"].message.copy()
+    block.body.attestations[0].signature = interop_secret_key(0).sign(
+        b"junk").serialize()
+    tampered = _resign(h, block)
+    calls = []
+
+    class Spy(SD.BlockSigDispatcher):
+        def submit(self, sets, slot=None):
+            calls.append(len(sets))
+            return super().submit(sets, slot=slot)
+
+    with overlap_knob(True):
+        out = _run(h, fx["pre"], tampered,
+                   strategy=SignatureStrategy.NO_VERIFICATION,
+                   dispatcher=Spy())
+    assert out[0] == "ok"      # tampered signature invisible by design
+    assert calls == []         # nothing accumulated → nothing dispatched
+
+
+def test_defer_sig_join_surfaces_error_at_finish(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    block = fx["signed"].message.copy()
+    block.body.attestations[0].signature = interop_secret_key(0).sign(
+        b"junk").serialize()
+    tampered = _resign(h, block)
+    state = fx["pre"].copy()
+    state = process_slots(state, int(block.slot), h.preset, h.spec, h.T)
+    with overlap_knob(True):
+        acc = process_block(state, tampered, h.fork_at(int(block.slot)),
+                            h.preset, h.spec, h.T,
+                            strategy=SignatureStrategy.VERIFY_BULK,
+                            defer_sig_join=True)
+        assert acc is not None
+        # The transition completed; the verdict only lands at the join.
+        with pytest.raises(InvalidSignaturesError):
+            acc.finish()
+        acc.finish()  # idempotent: second join is a no-op
+
+
+# ---------------------------------------------------------------------------
+# Breaker drill: device outage → host oracle, import still succeeds
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_open_falls_back_to_host_and_import_succeeds(pybls):
+    from lighthouse_tpu.beacon_chain.verification_service import (
+        ResilienceEnvelope)
+
+    fx = _harness_fixture()
+    h = fx["h"]
+    boom = []
+
+    def dead_device(sets):
+        boom.append(len(sets))
+        raise RuntimeError("device wedged")
+
+    env = ResilienceEnvelope("blocksig_drill", retries=0,
+                             breaker_threshold=1, probe_cooldown_s=60.0)
+    disp = SD.BlockSigDispatcher(
+        device_fn=dead_device,
+        host_fn=B._BACKENDS["python"].verify_signature_sets,
+        envelope=env)
+    with overlap_knob(True):
+        out = _run(h, fx["pre"], fx["signed"], dispatcher=disp)
+    assert out[0] == "ok"              # the block still imported
+    assert boom                        # the device leg really ran + died
+    assert env.breaker.state == "open"
+    split = tracing.stage_split("block_sigs")
+    assert split["path"] == "host"
+    # A tampered block through the SAME tripped dispatcher must still
+    # reject — the host oracle keeps the verdict exact.
+    block = fx["signed"].message.copy()
+    block.body.attestations[0].signature = interop_secret_key(0).sign(
+        b"junk").serialize()
+    with overlap_knob(True):
+        out = _run(h, fx["pre"], _resign(h, block), dispatcher=disp)
+    assert out == ("err", "InvalidSignaturesError")
+
+
+# ---------------------------------------------------------------------------
+# Typed error classification (satellite 1) — both directions
+# ---------------------------------------------------------------------------
+
+
+def _make_chain(h):
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    db = HotColdDB.memory(h.preset, h.spec, h.T)
+    return BeaconChain(store=db, genesis_state=h.state.copy(),
+                       genesis_block_root=hdr.tree_hash_root(),
+                       preset=h.preset, spec=h.spec, T=h.T)
+
+
+def test_tampered_signature_classifies_invalid_signatures(pybls):
+    from lighthouse_tpu.beacon_chain.errors import InvalidSignatures
+
+    h = StateHarness(n_validators=32, preset=MINIMAL)
+    chain = _make_chain(h)
+    for _ in range(2):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb, is_timely=True)
+    sb = h.build_block()
+    chain.per_slot_task(int(sb.message.slot))
+    block = sb.message
+    assert len(block.body.attestations) >= 1
+    block.body.attestations[0].signature = interop_secret_key(0).sign(
+        b"junk").serialize()
+    with pytest.raises(InvalidSignatures):
+        chain.process_block(_resign(h, block))
+
+
+def test_undecodable_signature_classifies_invalid_signatures(pybls):
+    """A BIT-FLIPPED (not-on-curve, undecodable) attestation signature
+    is signature material too: the codec's BlsError must classify as
+    InvalidSignatures, not fall through to InvalidBlock (curve.py
+    raises plain ValueError — bls wraps it at the checked-decode
+    layer)."""
+    from lighthouse_tpu.beacon_chain.errors import InvalidSignatures
+
+    h = StateHarness(n_validators=32, preset=MINIMAL)
+    chain = _make_chain(h)
+    for _ in range(2):
+        sb = h.build_block()
+        h.apply_block(sb)
+        chain.per_slot_task(int(sb.message.slot))
+        chain.process_block(sb, is_timely=True)
+    sb = h.build_block()
+    chain.per_slot_task(int(sb.message.slot))
+    block = sb.message
+    raw = bytearray(bytes(block.body.attestations[0].signature))
+    raw[20] ^= 0x40   # lands off-curve with overwhelming probability
+    block.body.attestations[0].signature = bytes(raw)
+    with pytest.raises(InvalidSignatures):
+        chain.process_block(_resign(h, block))
+
+
+def test_nonsignature_error_mentioning_signature_is_invalid_block(
+        pybls, monkeypatch):
+    """The regression the typed exception exists for: a ValueError whose
+    MESSAGE mentions "signature" but that is not a signature verdict
+    must classify as InvalidBlock (the old string matcher returned
+    InvalidSignatures here)."""
+    from lighthouse_tpu.beacon_chain.errors import (
+        InvalidBlock, InvalidSignatures)
+    from lighthouse_tpu.state_transition import per_block as PB
+
+    h = StateHarness(n_validators=32, preset=MINIMAL)
+    chain = _make_chain(h)
+    sb = h.build_block()
+    chain.per_slot_task(int(sb.message.slot))
+
+    def poisoned(state, eth1_data, preset):
+        raise ValueError(
+            "this error mentions the word signature but is NOT one")
+
+    monkeypatch.setattr(PB, "process_eth1_data", poisoned)
+    with pytest.raises(InvalidBlock) as ei:
+        chain.process_block(sb)
+    assert not isinstance(ei.value, InvalidSignatures)
+
+
+# ---------------------------------------------------------------------------
+# Satellite units: dedup, get_many, signing-root memo, K-bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_signature_sets_unit():
+    sk = B.SecretKey(7777)
+    pk = sk.public_key()
+    s1 = B.SignatureSet(sk.sign(b"m1"), [pk], b"m1")
+    s1b = B.SignatureSet(sk.sign(b"m1"), [pk], b"m1")   # exact dup
+    s2 = B.SignatureSet(sk.sign(b"m2"), [pk], b"m2")    # distinct msg
+    s3 = B.SignatureSet(sk.sign(b"m1"), [pk, pk], b"m1")  # distinct keys
+    out, dropped = B.dedup_signature_sets([s1, s1b, s2, s3, s2])
+    assert dropped == 2
+    assert out == [s1, s2, s3]
+    # Verdict identity on the python backend: dups in == dups out.
+    assert B._BACKENDS["python"].verify_signature_sets(
+        [s1, s1b, s2]) == B._BACKENDS["python"].verify_signature_sets(
+        [s1, s2])
+
+
+def test_duplicate_attestation_block_dedups_and_agrees(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    atts = list(fx["signed"].message.body.attestations)
+    assert atts
+    sb = h.build_block(attestations=[atts[0], atts[0]])
+    out = _differential(sb, ("ok", None))
+    assert out[0] == "ok"
+    with overlap_knob(True):
+        _run(h, fx["pre"], sb)
+    split = tracing.stage_split("block_sigs")
+    assert split["deduped"] >= 1
+
+
+def test_get_many_matches_scalar_get(pybls):
+    fx = _harness_fixture()
+    reg = fx["h"].state.validators
+    idx = np.array([0, 5, 3, 5, 0, 17], dtype=np.int64)
+    cache_a, cache_b = sigs.PubkeyCache(), sigs.PubkeyCache()
+    many = cache_a.get_many(reg, idx)
+    ones = [cache_b.get(reg, int(i)) for i in idx]
+    assert [k.point for k in many] == [k.point for k in ones]
+    # get_many fills the reverse map too (index_of hits the dict).
+    raw = reg.col("pubkey")[17].tobytes()
+    assert cache_a.index_of(reg, raw) == 17
+
+
+def test_get_many_bytes_handles_foreign_keys(pybls):
+    fx = _harness_fixture()
+    reg = fx["h"].state.validators
+    cache = sigs.PubkeyCache()
+    registry_raw = reg.col("pubkey")[2].tobytes()
+    foreign = B.SecretKey(123457).public_key().serialize()  # not in registry
+    out = cache.get_many_bytes(reg, [registry_raw, foreign, registry_raw])
+    assert out[0].point == out[2].point
+    assert out[1].point == B.PublicKey.deserialize(foreign).point
+
+
+def test_attestation_signing_root_memo_matches_direct(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    state = fx["pre"]
+    atts = list(fx["signed"].message.body.attestations)
+    roots = sigs.AttestationSigningRoots(state, h.preset)
+    for a in atts:
+        direct = compute_signing_root(
+            a.data, get_domain(state, Domain.BEACON_ATTESTER,
+                               a.data.target.epoch, h.preset))
+        assert roots.message(a.data) == direct
+        assert roots.message(a.data) == direct  # memo hit, same value
+
+
+def test_sync_aggregate_builder_cached_equals_direct(pybls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    state = fx["pre"].copy()
+    state = process_slots(state, int(fx["signed"].message.slot), h.preset,
+                          h.spec, h.T)
+    agg = fx["signed"].message.body.sync_aggregate
+
+    def root_fn(slot):
+        from lighthouse_tpu.state_transition.helpers import (
+            get_block_root_at_slot)
+        return get_block_root_at_slot(state, slot, h.preset)
+
+    direct = sigs.sync_aggregate_signature_set(
+        state, agg, state.slot, root_fn, h.preset)
+    cached = sigs.sync_aggregate_signature_set(
+        state, agg, state.slot, root_fn, h.preset,
+        pubkey_cache=sigs.PubkeyCache())
+    if direct is None:
+        assert cached is None
+        return
+    assert cached.message == direct.message
+    assert [k.point for k in cached.signing_keys] == \
+        [k.point for k in direct.signing_keys]
+
+
+def test_bucketed_sharded_groups_by_padded_k(monkeypatch):
+    from lighthouse_tpu.parallel import bls_shard
+
+    seen = []
+
+    def fake_sharded(sets, mesh, rand_fn=None):
+        seen.append((max(len(s.signing_keys) for s in sets), len(sets)))
+        return True
+
+    monkeypatch.setattr(bls_shard, "sharded_verify_signature_sets",
+                        fake_sharded)
+    mk = lambda nkeys: SimpleNamespace(signing_keys=[object()] * nkeys)
+    sets = [mk(1), mk(130), mk(1), mk(512), mk(100), mk(2)]
+    assert bls_shard.bucketed_verify_signature_sets(sets, mesh=None)
+    # Buckets in ascending padded-K order: 1-key pair, the 2-key set,
+    # the two committee-width sets (128/256 pads split), the sync-width.
+    assert seen == [(1, 2), (2, 1), (100, 1), (130, 1), (512, 1)]
+
+    # A failing bucket short-circuits to False.
+    calls = []
+
+    def failing(sets, mesh, rand_fn=None):
+        calls.append(len(sets))
+        return False
+
+    monkeypatch.setattr(bls_shard, "sharded_verify_signature_sets",
+                        failing)
+    assert not bls_shard.bucketed_verify_signature_sets(sets, mesh=None)
+    assert len(calls) == 1
+
+
+def test_xla_dispatch_worklist_groups_by_k():
+    from lighthouse_tpu.crypto import tpu_backend as TB
+
+    e = lambda nkeys: (object(), [object()] * nkeys, b"m")
+    entries = [e(1), e(130), e(1), e(512), e(100)]
+    work = TB._split_batches(entries)
+    ks = sorted({TB._next_pow2(len(it[1])) for batch in work
+                 for it in batch})
+    assert ks == [1, 128, 256, 512]
+    # Each work item is K-pure (no single-key set pads to K=512).
+    for batch in work:
+        kset = {TB._next_pow2(max(1, len(it[1]))) for it in batch}
+        assert len(kset) == 1
+
+
+# ---------------------------------------------------------------------------
+# Tracing: the sig_dispatch / sig_join / sig_device_verify spans
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_spans_land_in_slot_trace(fakebls):
+    fx = _harness_fixture()
+    h = fx["h"]
+    TR = tracing.TRACER
+    was_enabled = TR.enabled
+    try:
+        if not was_enabled:
+            TR.reset()
+        TR.enable()
+        with overlap_knob(True):
+            out = _run(h, fx["pre"], fx["signed"])
+        assert out[0] == "ok"
+        slot = int(fx["signed"].message.slot)
+        trace = TR.slot_trace(slot)
+        assert trace is not None
+        names = [s["name"] for s in trace["spans"]]
+        assert "sig_dispatch" in names
+        assert "sig_device_verify" in names
+        assert "sig_join" in names
+        # Dispatch precedes the deferred apply work: the dispatch span
+        # must START before the participation scatter lands (the stage
+        # children are laid out inside the block span; dispatch_ms is
+        # recorded as a block phase BEFORE deferred_apply_ms).
+        split = tracing.stage_split("block")
+        assert "sig_dispatch_ms" in split
+        assert "deferred_apply_ms" in split
+    finally:
+        if was_enabled:
+            TR.enable()
+        else:
+            TR.disable()
+            TR.reset()
+
+
+def test_sync_oracle_records_sync_path(fakebls):
+    fx = _harness_fixture()
+    with overlap_knob(False):
+        out = _run(fx["h"], fx["pre"], fx["signed"])
+    assert out[0] == "ok"
+    split = tracing.stage_split("block_sigs")
+    assert split["path"] == "sync"
+    assert split["overlapped"] is False
+    assert split["overlap_efficiency"] == 0.0
