@@ -74,6 +74,13 @@ each row tagged ``"backend_unavailable": true``, device-only rows are
 recorded in ``skipped``, and the process still exits 0 with a full
 combined line (VERDICT r5 item 1: BENCH json must never be empty).
 
+CLI: ``--list`` prints the row names; ``--only ROW[,ROW…]`` runs a
+subset (the per-row incremental emission is unchanged, but
+``BENCH_LATEST.json`` is left untouched so a subset run never guts the
+regression baseline).  A full run writes per-row snapshots to
+``BENCH_LATEST.json.tmp`` and renames over ``BENCH_LATEST.json`` once
+at end of run — a killed run cannot leave a truncated artifact.
+
 ``vs_baseline`` compares against a **native single-core blst estimate** of
 0.7 ms/set for ``verify_multiple_aggregate_signatures`` (1 Miller loop +
 G2 RLC scalar-mul + share of final exp per set; supranational's published
@@ -355,10 +362,11 @@ def _device_resident_state_root_bench() -> dict:
     of the cold row above is eliminated from the warm path, not
     overlapped.  Reports the materialize-once split, a zero-dirty warm
     root (bytes pushed ≈ 0), and a 0.1% / 1% / 10% dirty-fraction sweep
-    with bytes-pushed-per-root."""
+    with bytes-pushed-per-root.  Residency is read through the DEVICE
+    LEDGER snapshot (ISSUE 15) — per-subsystem attribution + HBM
+    watermarks ride along for free."""
     from lighthouse_tpu.common import tracing
-    from lighthouse_tpu.ops.device_tree import (residency_snapshot,
-                                                reset_residency_stats)
+    from lighthouse_tpu.common.device_ledger import LEDGER
     from lighthouse_tpu.types.device_state import materialize_state
     from lighthouse_tpu.types.presets import MAINNET
     from lighthouse_tpu.types.factory import spec_types
@@ -381,7 +389,15 @@ def _device_resident_state_root_bench() -> dict:
     state.current_epoch_participation = np.zeros(n, dtype=np.uint8)
     state.inactivity_scores = np.zeros(n, dtype=np.uint64)
 
-    reset_residency_stats()
+    from lighthouse_tpu.ops.device_tree import (
+        LEGACY_RESIDENCY_SUBSYSTEMS as _RESIDENCY_SUBS)
+    _base = {s: dict(row) for s, row
+             in LEDGER.snapshot()["subsystems"].items()}
+
+    def _pushed_bytes() -> int:
+        snap = LEDGER.snapshot()["subsystems"]
+        return sum(snap[s]["h2d_bytes"] for s in _RESIDENCY_SUBS)
+
     materialize_state(state)  # the ONE full-width push of this lineage
     mat = tracing.stage_split("materialize")
     out = {
@@ -390,12 +406,11 @@ def _device_resident_state_root_bench() -> dict:
     }
 
     def timed_root() -> tuple:
-        before = residency_snapshot()
+        before = _pushed_bytes()
         t0 = time.perf_counter()
         state.tree_hash_root()
         ms = (time.perf_counter() - t0) * 1e3
-        after = residency_snapshot()
-        return ms, after["bytes_pushed"] - before["bytes_pushed"]
+        return ms, _pushed_bytes() - before
 
     # Zero-dirty warm root: nothing to scatter — the headline "bytes
     # pushed per warm root ≈ 0 after materialization" number.
@@ -418,9 +433,25 @@ def _device_resident_state_root_bench() -> dict:
             pushed.append(nb)
         out[f"state_root_device_warm_{label}pct_ms"] = round(min(ts), 2)
         out[f"state_root_device_push_bytes_{label}pct"] = int(min(pushed))
-    stats = residency_snapshot()
+    # ONE consistent snapshot for the whole report (not one per cell).
+    snap = LEDGER.snapshot()["subsystems"]
+
+    def _delta(sub: str, key: str) -> int:
+        return int(snap[sub][key] - _base[sub][key])
+
     out["state_root_device_ops"] = {
-        k: stats[k] for k in ("scatters", "rebuilds", "materializes")}
+        k: sum(_delta(s, k) for s in _RESIDENCY_SUBS)
+        for k in ("scatters", "rebuilds", "materializes")}
+    # Per-subsystem attribution of this row's device traffic + the HBM
+    # watermarks the materialized state holds (the ledger's new axis).
+    out["state_root_device_ledger"] = {
+        s: {"h2d_bytes": _delta(s, "h2d_bytes"),
+            "d2h_bytes": _delta(s, "d2h_bytes"),
+            "resident_bytes": snap[s]["resident_bytes"],
+            "hbm_high_water_bytes": snap[s]["hbm_high_water_bytes"]}
+        for s in _RESIDENCY_SUBS
+        if any(_delta(s, k) for k in ("h2d_bytes", "d2h_bytes"))
+        or snap[s]["resident_bytes"]}
     return out
 
 
@@ -947,14 +978,27 @@ def _stream_verify_bench() -> dict:
     valid message lost despite the outage (host fallback carried the
     stream, the breaker re-closed after recovery).  Pure host logic —
     survives a dead backend (`--host-only`)."""
+    from lighthouse_tpu.common.device_ledger import LEDGER
     from lighthouse_tpu.testing.stream_drill import run_drill
 
+    # Device-ledger attribution of the drill (ISSUE 15): dispatch
+    # counts + verify wall through the envelope seam, read from the
+    # ledger snapshot rather than any module-global residency dict.
+    _base = {k: v for k, v in LEDGER.snapshot()["subsystems"]
+             ["bls"].items()}
     out = run_drill(n_messages=256, rate_per_s=2000.0, burst_every=32,
                     burst_size=16, fail_rate=0.10, outage=(6, 14),
                     slo_ms=50.0, max_batch=32, backend="fake",
                     realtime=True, dispatch_model_ms=(2.0, 0.05), seed=0)
     env = out["envelope"]
+    _bls = LEDGER.snapshot()["subsystems"]["bls"]
     return {
+        "stream_ledger_device_dispatches":
+            int(_bls["dispatches"] - _base["dispatches"]),
+        "stream_ledger_device_verify_total_ms":
+            round(_bls["device_ms"] - _base["device_ms"], 2),
+        "stream_ledger_h2d_bytes":
+            int(_bls["h2d_bytes"] - _base["h2d_bytes"]),
         "stream_messages": out["messages"],
         "stream_zero_loss": out["zero_loss"],
         "stream_recovered": out["recovered"],
@@ -1004,6 +1048,11 @@ def _sustained_slo_bench() -> dict:
             for t in board["health"]["transitions"]],
         "sustained_outage_attributed":
             board["fault_attribution"]["attributed"],
+        # Warm-slot device-transfer budget (ISSUE 15): the SLO-style
+        # attainment row the device ledger exports through the drill.
+        "sustained_device_budget_ok": board["device_budget"]["ok"],
+        "sustained_device_budget_attainment":
+            board["device_budget"]["attainment"],
     }
     for row in board["objectives"]:
         name = row["name"]
@@ -1375,9 +1424,59 @@ def _regressions(merged: dict) -> dict:
     return {"compared": compared, "flagged": flagged}
 
 
+def _parse_cli(argv: list) -> tuple:
+    """Minimal CLI: ``--list`` prints the row names and exits;
+    ``--only ROW[,ROW…]`` (or ``--only=ROW[,…]``) runs a subset.
+    Unknown flags are refused — before this, ANY argv ran the full
+    bench, so a typo'd flag silently cost a full run."""
+    only = None
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--host-only":
+            i += 1
+            continue
+        if arg == "--list":
+            for name, _fn, metric, needs_device in _ROWS:
+                print(f"{name:14s} -> {metric}"
+                      + ("" if needs_device else "  [host-ok]"))
+            raise SystemExit(0)
+        if arg == "--only" or arg.startswith("--only="):
+            if arg == "--only":
+                if i + 1 >= len(argv):
+                    print("bench: --only needs ROW[,ROW…] "
+                          "(see --list)", file=sys.stderr)
+                    raise SystemExit(2)
+                spec = argv[i + 1]
+                i += 2
+            else:
+                spec = arg.split("=", 1)[1]
+                i += 1
+            names = [r for r in spec.split(",") if r]
+            if not names:
+                # `--only=` / `--only ,,`: refusing beats silently
+                # running ZERO rows and exiting 0 as if measured.
+                print("bench: --only got an empty row list "
+                      "(see --list)", file=sys.stderr)
+                raise SystemExit(2)
+            known = {name for name, _f, _m, _d in _ROWS}
+            bad = sorted(set(names) - known)
+            if bad:
+                print(f"bench: unknown row(s) {bad}; known: "
+                      f"{sorted(known)}", file=sys.stderr)
+                raise SystemExit(2)
+            only = set(names)
+            continue
+        print(f"bench: unknown argument {arg!r} (use --list / "
+              f"--only ROW[,ROW…] / --host-only)", file=sys.stderr)
+        raise SystemExit(2)
+    return (only,)
+
+
 def main() -> None:
     host_only = "--host-only" in sys.argv[1:] \
         or os.environ.get("BENCH_HOST_ONLY") == "1"
+    (only,) = _parse_cli(sys.argv[1:])
     if host_only:
         # Pin jax to CPU BEFORE any backend initializes (env vars are
         # too late under this environment's sitecustomize, which already
@@ -1421,6 +1520,8 @@ def main() -> None:
         {"backend_error": backend_err} if backend_err else {})
     skipped: list = []
     for name, fn, metric, needs_device in _ROWS:
+        if only is not None and name not in only:
+            continue
         if host_only and needs_device:
             skipped.append(name)
             _emit({"metric": metric, "skipped": "backend_unavailable"})
@@ -1451,13 +1552,31 @@ def main() -> None:
                **row, **extra})
         combined = _combined(merged, skipped)
         _emit(combined)  # tail capture always ends on a full record
-        try:  # supplementary snapshot for post-hoc inspection
-            with open("BENCH_LATEST.json", "w") as f:
+        # ATOMICITY: per-row snapshots land in a temp file; the real
+        # BENCH_LATEST.json is replaced ONCE by the rename at end of
+        # run — a killed run can no longer leave a truncated/partial
+        # artifact that guts the regression baseline.
+        try:
+            with open("BENCH_LATEST.json.tmp", "w") as f:
                 json.dump(combined, f)
         except OSError:
             pass
 
-    print(json.dumps(_combined(merged, skipped)))
+    combined = _combined(merged, skipped)
+    print(json.dumps(combined))
+    if only is not None:
+        # A subset run would overwrite the full snapshot with a slice —
+        # keep the regression baseline intact and leave only the temp.
+        print(json.dumps({"metric": "bench_latest",
+                          "note": "subset run (--only): "
+                                  "BENCH_LATEST.json left untouched"}))
+        return
+    try:
+        with open("BENCH_LATEST.json.tmp", "w") as f:
+            json.dump(combined, f)
+        os.replace("BENCH_LATEST.json.tmp", "BENCH_LATEST.json")
+    except OSError:
+        pass
 
 
 def _combined(merged: dict, skipped: list) -> dict:

@@ -1,5 +1,6 @@
 """graftlint checkers — importing this package registers them all."""
 
+from . import device_accounting  # noqa: F401
 from . import jax_hygiene    # noqa: F401
 from . import knob_registry  # noqa: F401
 from . import locks          # noqa: F401

@@ -1,0 +1,157 @@
+"""device-accounting — device I/O only inside ledger-annotated seams.
+
+ISSUE 15 made :mod:`lighthouse_tpu.common.device_ledger` the ONE
+accounting layer for host↔device traffic: every transfer is attributed
+to a subsystem (``LEDGER.note_transfer`` / the ambient
+``LEDGER.attribute`` context / an executor's ``subsystem=`` parameter).
+A raw ``jax.device_put`` added outside those seams moves bytes the
+ledger never sees — the warm-slot budget check and the per-slot
+scoreboard silently under-report, which is exactly the "accounting
+drifts from reality" failure the ledger exists to prevent.
+
+A device-I/O call site must therefore carry a **seam annotation**: a
+``# device-io: <subsystem>`` comment on the call's own line or on the
+``def`` line of an enclosing function, with ``<subsystem>`` one of the
+:data:`~lighthouse_tpu.common.device_ledger.SUBSYSTEMS` enum (the
+annotation marks a REVIEWED seam whose bytes are accounted nearby — or
+argued negligible, e.g. 32-byte root reads).  Unannotated sites are
+findings, baseline-waivable with justification like every other
+checker.
+
+What counts as device I/O (lexical, like ``store-write``):
+
+1. ``jax.device_put(...)`` / ``jax.device_get(...)`` anywhere in
+   ``lighthouse_tpu/`` — the explicit transfer primitives.
+2. ``jnp.asarray(...)`` inside the DEVICE SUBSYSTEM modules
+   (:data:`DEVICE_MODULES`) — there, asarray IS the H2D staging call.
+   Crypto/kernel modules are exempt: their ``jnp.asarray`` sites are
+   trace-time constant material inside jit bodies, not runtime
+   transfers (their real transfers are implicit jit-argument staging,
+   accounted explicitly at the dispatch seams).
+3. ``np.asarray(<device-suggestive>)`` / ``np.array(<device-
+   suggestive>)`` anywhere — the D2H pull idioms — where the
+   argument's name chain looks device-resident: a segment ending in
+   ``_dev`` or ``_plane``, or equal to ``levels``.  A pull of a
+   plainly-named local is NOT caught (lexical checker, documented
+   limitation; the in-tree pull seams use the covered names).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from ...common.device_ledger import SUBSYSTEMS
+from ..core import Checker, Context, Finding, dotted, register
+
+PACKAGE = "lighthouse_tpu/"
+
+# Modules whose jnp.asarray calls are runtime H2D staging (the device
+# subsystems themselves), not trace-time constants.
+DEVICE_MODULES = frozenset({
+    "lighthouse_tpu/ops/device_tree.py",
+    "lighthouse_tpu/ops/merkle_kernel.py",
+    "lighthouse_tpu/types/device_state.py",
+    "lighthouse_tpu/types/validators.py",
+    "lighthouse_tpu/fork_choice/device_proto_array.py",
+    "lighthouse_tpu/slasher/device_spans.py",
+    "lighthouse_tpu/parallel/pipeline.py",
+    "lighthouse_tpu/kzg/device.py",
+})
+
+ANNOTATION_RE = re.compile(r"#\s*device-io:\s*([a-z_]+)")
+
+_DEV_SEGMENT = re.compile(r"(_dev|_plane)$|^levels$")
+
+
+def _annotation(line: str) -> Optional[str]:
+    m = ANNOTATION_RE.search(line)
+    return m.group(1) if m else None
+
+
+def _unwrap(node: ast.AST) -> ast.AST:
+    """Peel subscripts/calls so ``self.levels[-1]`` resolves to its
+    base chain."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def _device_suggestive(node: ast.AST) -> bool:
+    chain = dotted(_unwrap(node))
+    if not chain:
+        return False
+    return any(_DEV_SEGMENT.search(seg) for seg in chain.split("."))
+
+
+@register
+class DeviceAccountingChecker(Checker):
+    name = "device-accounting"
+    doc = ("raw jax.device_put / jnp.asarray / np.asarray(device_array) "
+           "device I/O outside a '# device-io: <subsystem>' annotated "
+           "ledger seam")
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        if not path.startswith(PACKAGE):
+            return []
+        out: List[Finding] = []
+        self._walk(tree, path, lines, out, def_stack=[])
+        return out
+
+    def _walk(self, node: ast.AST, path: str, lines,
+              out: List[Finding], def_stack: List[int]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            def_stack = def_stack + [node.lineno]
+        elif isinstance(node, ast.Call):
+            self._call(node, path, lines, out, def_stack)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, path, lines, out, def_stack)
+
+    def _seam(self, lineno: int, lines,
+              def_stack: List[int]) -> Optional[str]:
+        """The governing annotation: the call's own line, else the
+        nearest enclosing ``def`` line (first line of the signature)."""
+        for ln in [lineno] + list(reversed(def_stack)):
+            if 0 < ln <= len(lines):
+                sub = _annotation(lines[ln - 1])
+                if sub is not None:
+                    return sub
+        return None
+
+    def _call(self, node: ast.Call, path: str, lines,
+              out: List[Finding], def_stack: List[int]) -> None:
+        chain = dotted(node.func) or ""
+        kind = None
+        if chain in ("jax.device_put", "jax.device_get") or \
+                chain.endswith(".device_put") or \
+                chain.endswith(".device_get"):
+            kind = chain.rsplit(".", 1)[-1]
+        elif chain in ("jnp.asarray", "jax.numpy.asarray") \
+                and path in DEVICE_MODULES:
+            kind = "jnp.asarray"
+        elif chain in ("np.asarray", "numpy.asarray",
+                       "np.array", "numpy.array") and node.args \
+                and _device_suggestive(node.args[0]):
+            kind = "np.asarray(device_array)"
+        if kind is None:
+            return
+        sub = self._seam(node.lineno, lines, def_stack)
+        if sub is None:
+            out.append(Finding(
+                self.name, path, node.lineno,
+                f"raw {kind} device I/O outside an annotated ledger "
+                f"seam — bytes the device ledger never sees",
+                hint="account the transfer (LEDGER.note_transfer / an "
+                     "executor subsystem=) and mark the seam with "
+                     "'# device-io: <subsystem>' on the call or its "
+                     "enclosing def",
+                detail=f"unannotated:{kind}"))
+        elif sub not in SUBSYSTEMS:
+            out.append(Finding(
+                self.name, path, node.lineno,
+                f"device-io annotation names unknown subsystem "
+                f"{sub!r} (enum: {', '.join(SUBSYSTEMS)})",
+                hint="use a device_ledger.SUBSYSTEMS member",
+                detail=f"bad-subsystem:{sub}"))

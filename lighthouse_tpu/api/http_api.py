@@ -504,6 +504,31 @@ class HttpApiServer:
                 if engine.enabled:
                     engine.tick()
                 h._json({"data": engine.report()})
+        elif path == "/lighthouse/device":
+            # Device-ledger scoreboard: per-subsystem transfer bytes/
+            # ops, HBM residency watermarks, dispatch + compile counts,
+            # the per-slot transfer-delta ring (keyed to the same slot
+            # numbers as the trace ring), and the warm-slot budget
+            # evaluated over the held slots.
+            from ..common.device_ledger import (LEDGER, WARM_SLOT_BUDGET,
+                                                evaluate_budget)
+            snap = LEDGER.snapshot()
+            deltas = LEDGER.slot_deltas()
+            snap["slots"] = deltas
+            snap["current_slot_delta"] = {
+                s: row
+                for s, row in LEDGER.current_slot_delta().items()
+                if any(row.values())}
+            # include_cold=False: a fresh node's materialize/cold-build
+            # slots must not read as warm-path violations here (skipped
+            # slots are listed; the sustained drill enforces ALL of its
+            # measured slots).
+            snap["budget"] = {
+                "bytes_per_slot": WARM_SLOT_BUDGET,
+                "evaluation": evaluate_budget(deltas,
+                                              include_cold=False),
+            }
+            h._json({"data": snap})
         elif path.startswith("/lighthouse/health"):
             # Node health: 200 when healthy/degraded (the node serves),
             # 503 when unhealthy (load balancers drain it).  An empty

@@ -154,8 +154,13 @@ class BeaconChain:
         (unregistered) so a multi-node test process never mixes peers'
         imports into one node's objective; bucket bounds bracket the
         150 ms block budget exactly."""
+        from ..common.device_ledger import LEDGER
         from ..common.slo import (SloEngine, default_objectives,
                                   wire_chain_feeds)
+        # Device-ledger Prometheus families ride chain construction
+        # (both __init__ and the resume path land here) — a bare
+        # library import never touches the registry.
+        LEDGER.register_metrics()
         self._slo_import_hist = Histogram(
             "block_import_seconds_local", "",
             buckets=(0.005, 0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25,
@@ -374,6 +379,11 @@ class BeaconChain:
     def per_slot_task(self, slot: int) -> None:
         """`timer` service hook (`beacon_chain.rs:5322`)."""
         TRACER.set_slot(slot)  # ambient slot scope for this tick's spans
+        # Ledger slot boundary: close the previous slot's device-transfer
+        # delta (the /lighthouse/device per-slot view, keyed like the
+        # trace ring; idempotent when several nodes tick the same slot).
+        from ..common.device_ledger import LEDGER
+        LEDGER.mark_slot(slot)
         # SLO evaluation rides the timer tick (rate-limited inside) —
         # off the import/verify hot paths by construction.
         self.slo_engine.tick()

@@ -78,6 +78,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..common.backoff import backoff_delay
+from ..common.device_ledger import LEDGER
 from ..common.metrics import REGISTRY, Histogram, observe
 from ..common.tracing import TRACER
 from ..ops.merkle import _next_pow2
@@ -408,6 +409,9 @@ class ResilienceEnvelope:
         # whole call would poison the EWMA with seconds of backoff
         # after one fault burst, collapsing batches to singletons).
         self.last_attempt_s: Optional[float] = None
+        # Device-ledger attribution: every envelope family is a bls or a
+        # kzg dispatch stream (the two device verify families).
+        self._ledger_subsystem = "kzg" if "kzg" in name else "bls"
         self._m_faults = REGISTRY.counter(
             f"{name}_device_faults_total", "device dispatch failures")
         self._m_fallbacks = REGISTRY.counter(
@@ -426,12 +430,23 @@ class ResilienceEnvelope:
             inner = self._faults.wrap(self._fault_site, fn)
         else:
             inner = fn
+
+        # The envelope OWNS the dispatch accounting (recorded once on
+        # success in _call_inner): suppress the wrapped path's own
+        # note_dispatch seams (kzg pairing, direct XLA verify) or every
+        # enveloped call counts twice.  Wrap the FN, not the call site —
+        # under a deadline the watchdog pool runs it on another thread
+        # and the suppression flag is thread-local.
+        def guarded(*a):
+            with LEDGER.suppress_dispatch():
+                return inner(*a)
+
         if deadline_s is None:
-            return inner(*args)
+            return guarded(*args)
         # Pooled watchdog: a wedged device call is abandoned (its worker
         # thread dies with it), never waited on; completed workers are
         # reused instead of spawning a thread per attempt.
-        return _WATCHDOGS.call(inner, args, deadline_s, self.name)
+        return _WATCHDOGS.call(guarded, args, deadline_s, self.name)
 
     def call(self, device_fn: Callable, host_fn: Optional[Callable],
              args: tuple = (), *, deadline_s=False,
@@ -487,6 +502,11 @@ class ResilienceEnvelope:
                 else:
                     self.breaker.record(True, probe=probe)
                     self._bump("device_ok")
+                    # Ledger seam: one successful device dispatch + its
+                    # verify wall time (host fallbacks don't count —
+                    # the ledger answers "what ran on the device").
+                    LEDGER.note_dispatch(self._ledger_subsystem,
+                                         self.last_attempt_s * 1e3)
                     return out, ("probe" if probe
                                  else "device_retry" if i else "device")
         if host_fn is None:
@@ -869,7 +889,8 @@ class VerificationService:
             return 0
         stage = (self._faults.stage_wrapper(_default_stage)
                  if self._faults is not None else None)
-        ex = StagedExecutor("stream_verify", stage=stage)
+        ex = StagedExecutor("stream_verify", stage=stage,
+                            subsystem="bls")
         try:
             sum(ex.map(work, self._prep_bucket, self._dispatch_bucket))
         except Exception:  # noqa: BLE001 — a staging-machinery failure

@@ -95,6 +95,11 @@ def install_monitoring() -> bool:
         return False
     from .metrics import REGISTRY
     REGISTRY.register_collector(_collect_cache_misses)
+    # The device ledger taps the SAME event stream for per-subsystem
+    # compile attribution — one listener each (the ledger's install is
+    # idempotent, so the two never double-register).
+    from .device_ledger import LEDGER
+    LEDGER._maybe_install_listener()
     _state["monitoring"] = True
     return True
 

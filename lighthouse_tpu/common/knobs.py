@@ -165,6 +165,12 @@ _declare("LIGHTHOUSE_TPU_TRACE", "bool", False,
          "Enable slot-scope tracing at import.")
 _declare("LIGHTHOUSE_TPU_TRACE_RING", "int", 64,
          "Fully-assembled slot traces kept in the ring.", min_value=1)
+_declare("LIGHTHOUSE_TPU_DEVICE_LEDGER", "bool", True,
+         "Device ledger: per-subsystem HBM/transfer/compile accounting "
+         "(0 freezes all counters — escape hatch only).")
+_declare("LIGHTHOUSE_TPU_DEVICE_LEDGER_SLOTS", "int", 64,
+         "Per-slot device-transfer delta entries kept in the ledger "
+         "ring.", min_value=1)
 
 # -- SLO engine / node health --
 _declare("LIGHTHOUSE_TPU_SLO", "bool", True,

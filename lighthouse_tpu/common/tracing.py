@@ -380,22 +380,36 @@ class Tracer:
     # -- device residency attribution ---------------------------------------
 
     def residency_mark(self) -> Optional[dict]:
-        """Snapshot ``RESIDENCY_STATS`` for delta attribution (pair with
+        """Snapshot ``RESIDENCY_STATS`` plus the device ledger's
+        per-subsystem transfer totals for delta attribution (pair with
         :meth:`record_residency`)."""
         if not self.enabled:
             return None
         from ..ops.device_tree import residency_snapshot
-        return residency_snapshot()
+        from .device_ledger import LEDGER
+        mark = residency_snapshot()
+        mark["_ledger"] = LEDGER.transfer_totals()
+        return mark
 
     def record_residency(self, span, mark: Optional[dict]) -> None:
         """Attach the device push/pull byte deltas since ``mark`` to
-        ``span`` — the device-stage attribution of a transition."""
+        ``span`` — both the legacy flat ``residency_*`` totals and the
+        ledger's per-subsystem ``dev_<subsystem>_<dir>_bytes`` split
+        (the device-stage attribution of a transition)."""
         if mark is None or not self.enabled:
             return
         from ..ops.device_tree import residency_snapshot
+        from .device_ledger import LEDGER
+        ledger_mark = mark.pop("_ledger", {})
         after = residency_snapshot()
         delta = {f"residency_{k}": after[k] - mark[k]
                  for k in mark if after.get(k, 0) != mark[k]}
+        for s, (h2d, d2h) in LEDGER.transfer_totals().items():
+            b_h2d, b_d2h = ledger_mark.get(s, (0, 0))
+            if h2d != b_h2d:
+                delta[f"dev_{s}_h2d_bytes"] = h2d - b_h2d
+            if d2h != b_d2h:
+                delta[f"dev_{s}_d2h_bytes"] = d2h - b_d2h
         if delta:
             span.set(**delta)
 
@@ -553,6 +567,11 @@ def _src_block_sigs() -> dict:
     return LAST_SIG_DISPATCH
 
 
+def _src_device_ledger() -> dict:
+    from .device_ledger import LEDGER
+    return LEDGER.stage_dict()
+
+
 _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "block": _src_block,
     "epoch": _src_epoch,
@@ -565,6 +584,7 @@ _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "pipeline": _src_pipeline,
     "materialize": _src_materialize,
     "block_sigs": _src_block_sigs,
+    "device_ledger": _src_device_ledger,
 }
 
 

@@ -474,7 +474,7 @@ def _dispatch_pallas(entries, rand_fn) -> bool:
     _PK_TABLE.maybe_reset()
     work = _split_batches(entries)
     fused = fused_pipeline_jit()
-    ex = StagedExecutor("bls_pipeline")
+    ex = StagedExecutor("bls_pipeline", subsystem="bls")
 
     def dispatch(staged):
         (idx, kmask, lo, hi, u, sig, sigmask, setlive, K) = staged
@@ -802,17 +802,34 @@ def _dispatch(entries, rand_fn) -> bool:
             # PIPELINE_SETS=0 disables the staged machinery (the
             # debugging oracle): K-groups dispatch sequentially, one
             # monolithic marshal + kernel each.
-            return all(
-                bool(_verify_sets_kernel(*_marshal_xla(batch, rand_fn)))
-                for batch in work)
+            return all(_verify_xla_direct(batch, rand_fn)
+                       for batch in work)
         from ..parallel.pipeline import StagedExecutor
-        ex = StagedExecutor("bls_pipeline")
+        ex = StagedExecutor("bls_pipeline", subsystem="bls")
         outs = ex.map(
             work,
             lambda batch: _marshal_xla(batch, rand_fn),
             lambda staged: _verify_sets_kernel(*staged))
         return all(bool(o) for o in outs)
-    return bool(_verify_sets_kernel(*_marshal_xla(entries, rand_fn)))
+    return _verify_xla_direct(entries, rand_fn)
+
+
+def _verify_xla_direct(batch, rand_fn) -> bool:
+    """One monolithic marshal + XLA kernel call, with the implicit jit
+    staging accounted into the device ledger (the staged-executor paths
+    account theirs at the staging seam)."""
+    import time as _time
+    from ..common.device_ledger import LEDGER
+
+    args = _marshal_xla(batch, rand_fn)
+    LEDGER.note_transfer(
+        "h2d", sum(int(a.nbytes) for a in args if hasattr(a, "nbytes")),
+        subsystem="bls")
+    t0 = _time.perf_counter()
+    ok = bool(_verify_sets_kernel(*args))
+    LEDGER.note_dispatch("bls", (_time.perf_counter() - t0) * 1e3)
+    LEDGER.note_transfer("d2h", 1, subsystem="bls")
+    return ok
 
 
 def _host_fastpath_max() -> int:

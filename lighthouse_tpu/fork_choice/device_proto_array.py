@@ -224,7 +224,7 @@ class _DeviceMirror:
                  n_nodes: int):
         import jax.numpy as jnp
         from jax.experimental import enable_x64
-        from ..ops.device_tree import note_push
+        from ..common.device_ledger import LEDGER
 
         self.nv_pad = _bucket(max(len(votes), 1))
         self.n_pad = _bucket(max(n_nodes, 1))
@@ -236,14 +236,26 @@ class _DeviceMirror:
             ob = np.zeros(self.nv_pad, np.int64)
             m = min(old_balances.shape[0], len(votes))
             ob[:m] = old_balances[:m].astype(np.int64)
-            self.cur = jnp.asarray(cur)
-            self.nxt = jnp.asarray(nxt)
-            self.old_b = jnp.asarray(ob)
-        note_push(cur.nbytes + nxt.nbytes + ob.nbytes)
+            self.cur = jnp.asarray(cur)    # device-io: fork_choice
+            self.nxt = jnp.asarray(nxt)    # device-io: fork_choice
+            self.old_b = jnp.asarray(ob)   # device-io: fork_choice
+        LEDGER.note_transfer("h2d", cur.nbytes + nxt.nbytes + ob.nbytes,
+                             subsystem="fork_choice")
         self.topo_version = -1  # force first topology push
         self.parent = None
         self.depth = None
         self.weight = None
+        self._res = LEDGER.track(self, "fork_choice", 0)
+        self._note_residency()
+
+    def _note_residency(self) -> None:
+        # Dedupe by buffer identity: after a jitted apply `cur` IS
+        # `nxt` (the device-side vote move aliases them) — summing both
+        # would overstate residency by a full vote column.
+        cols = {id(c): c for c in (self.cur, self.nxt, self.old_b,
+                                   self.parent, self.depth, self.weight)
+                if c is not None}
+        self._res.set(sum(int(c.nbytes) for c in cols.values()))
 
     def fits(self, votes: VoteBuffer, n_nodes: int) -> bool:
         return len(votes) <= self.nv_pad and n_nodes <= self.n_pad
@@ -262,7 +274,7 @@ class _DeviceMirror:
             return
         import jax.numpy as jnp
         from jax.experimental import enable_x64
-        from ..ops.device_tree import note_push
+        from ..common.device_ledger import LEDGER
         k_pad = _bucket(wv.shape[0], floor=8)
         idx = np.empty(k_pad, np.int32)
         val = np.empty(k_pad, np.int32)
@@ -272,15 +284,17 @@ class _DeviceMirror:
         val[wn.shape[0]:] = wn[0]
         with enable_x64():
             self.nxt = _get_scatter(self.nv_pad, k_pad)(
-                self.nxt, jnp.asarray(idx), jnp.asarray(val))
-        note_push(idx.nbytes + val.nbytes)
+                self.nxt, jnp.asarray(idx), jnp.asarray(val))  # device-io: fork_choice
+        LEDGER.note_transfer("h2d", idx.nbytes + val.nbytes,
+                             subsystem="fork_choice")
+        self._note_residency()  # cur/nxt diverge into two buffers here
 
     def push_topology(self, cols: NodeColumns, version: int) -> None:
         if self.topo_version == version and self.parent is not None:
             return
         import jax.numpy as jnp
         from jax.experimental import enable_x64
-        from ..ops.device_tree import note_push
+        from ..common.device_ledger import LEDGER
         n = cols.n
         parent = np.full(self.n_pad, -1, np.int32)
         parent[:n] = cols.parent[:n]
@@ -289,11 +303,14 @@ class _DeviceMirror:
         weight = np.zeros(self.n_pad, np.int64)
         weight[:n] = cols.weight[:n]
         with enable_x64():
-            self.parent = jnp.asarray(parent)
-            self.depth = jnp.asarray(depth)
-            self.weight = jnp.asarray(weight)
-        note_push(parent.nbytes + depth.nbytes + weight.nbytes)
+            self.parent = jnp.asarray(parent)   # device-io: fork_choice
+            self.depth = jnp.asarray(depth)     # device-io: fork_choice
+            self.weight = jnp.asarray(weight)   # device-io: fork_choice
+        LEDGER.note_transfer(
+            "h2d", parent.nbytes + depth.nbytes + weight.nbytes,
+            subsystem="fork_choice")
         self.topo_version = version
+        self._note_residency()
 
 
 class DeviceProtoArrayForkChoice:
@@ -426,8 +443,12 @@ class DeviceProtoArrayForkChoice:
                 nb[:self._pending_new_b.shape[0]] = \
                     self._pending_new_b.astype(np.int64)
                 with enable_x64():
-                    self._mirror.old_b = jnp.asarray(nb)
+                    self._mirror.old_b = jnp.asarray(nb)  # device-io: fork_choice
                     self._mirror.cur = self._mirror.nxt
+                from ..common.device_ledger import LEDGER
+                LEDGER.note_transfer("h2d", nb.nbytes,
+                                     subsystem="fork_choice")
+                self._mirror._note_residency()
                 self._pending_new_b = None
             if self.cols.max_depth() > self.jit_max_depth:
                 # Chain-shaped tree: run this head round on host, but
@@ -490,8 +511,12 @@ class DeviceProtoArrayForkChoice:
             nb = np.zeros(self._mirror.nv_pad, np.int64)
             nb[:new_b.shape[0]] = new_b.astype(np.int64)
             with enable_x64():
-                self._mirror.old_b = jnp.asarray(nb)
+                self._mirror.old_b = jnp.asarray(nb)  # device-io: fork_choice
                 self._mirror.cur = self._mirror.nxt
+            from ..common.device_ledger import LEDGER
+            LEDGER.note_transfer("h2d", nb.nbytes,
+                                 subsystem="fork_choice")
+            self._mirror._note_residency()
             # host apply will move weights: force a weight re-push on
             # the next kernel dispatch even if the topology is unchanged
             self._mirror.topo_version = -1
@@ -534,11 +559,12 @@ class DeviceProtoArrayForkChoice:
         self.prev_boost_root = bytes(proposer_boost_root)
         self.prev_boost_score = new_boost
 
-    def _apply_jit(self, viable, invalid, pb_idx, pb_score, b_idx,
+    def _apply_jit(self, viable, invalid, pb_idx, pb_score, b_idx,  # device-io: fork_choice
                    b_score) -> None:
+        import time as _time
         import jax.numpy as jnp
         from jax.experimental import enable_x64
-        from ..ops.device_tree import note_pull, note_push
+        from ..common.device_ledger import LEDGER
 
         cols = self.cols
         n = cols.n
@@ -561,6 +587,10 @@ class DeviceProtoArrayForkChoice:
         new_b = np.zeros(mir.nv_pad, np.int64)
         new_b[:self._pending_new_b.shape[0]] = \
             self._pending_new_b.astype(np.int64)
+        # Clock from the staging/kernel block only (the slasher/kzg/bls
+        # convention): the np.full marshalling above is host prep, not
+        # device-verify time.
+        t_dispatch = _time.perf_counter()
         with enable_x64():
             kernel = _get_kernel(n_pad, mir.nv_pad)
             new_b_dev = jnp.asarray(new_b)
@@ -577,13 +607,20 @@ class DeviceProtoArrayForkChoice:
             mir.cur = mir.nxt
             mir.old_b = new_b_dev
             mir.weight = weight
-            w_host = np.asarray(weight)[:n]
-            bc_host = np.asarray(bc)[:n]
-            bd_host = np.asarray(bd)[:n]
+            w_host = np.asarray(weight)[:n]    # device-io: fork_choice
+            bc_host = np.asarray(bc)[:n]       # device-io: fork_choice
+            bd_host = np.asarray(bd)[:n]       # device-io: fork_choice
             neg = bool(negflag)
-        note_push(inv.nbytes + zr.nbytes + via.nbytes + rank.nbytes
-                  + bc_in.nbytes + bd_in.nbytes + new_b.nbytes)
-        note_pull(w_host.nbytes + bc_host.nbytes + bd_host.nbytes + 1)
+        LEDGER.note_transfer(
+            "h2d", inv.nbytes + zr.nbytes + via.nbytes + rank.nbytes
+            + bc_in.nbytes + bd_in.nbytes + new_b.nbytes,
+            subsystem="fork_choice")
+        LEDGER.note_transfer(
+            "d2h", w_host.nbytes + bc_host.nbytes + bd_host.nbytes + 1,
+            subsystem="fork_choice")
+        LEDGER.note_dispatch(
+            "fork_choice", (_time.perf_counter() - t_dispatch) * 1e3)
+        mir._note_residency()
         cols.weight[:n] = w_host
         cols.best_child[:n] = bc_host
         cols.best_desc[:n] = bd_host

@@ -79,7 +79,7 @@ def device_default() -> bool:
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnums=(3,))
-def _eval_kernel(f: jnp.ndarray, z: jnp.ndarray, roots: jnp.ndarray,
+def _eval_kernel(f: jnp.ndarray, z: jnp.ndarray, roots: jnp.ndarray,  # device-io: kzg
                  width: int) -> jnp.ndarray:
     """f: (B, W, 17) Montgomery evals; z: (B, 17); roots: (W, 17).
     Returns (B, 17) Montgomery p_i(z_i)."""
@@ -123,17 +123,25 @@ def _roots_limbs(setup: TrustedSetup) -> np.ndarray:
     return limbs
 
 
-def eval_blobs(polys, zs, setup: TrustedSetup) -> list:
+def eval_blobs(polys, zs, setup: TrustedSetup) -> list:  # device-io: kzg
     """Batched p_i(z_i) for B polynomials (lists of Fr ints) at B points.
     Host↔device conversion at the edges, ints in and out."""
     B = len(polys)
     if B == 0:
         return []
+    from ..common.device_ledger import LEDGER
     f = FL.to_mont_array(polys)                    # (B, W, 17)
     z = FL.to_mont_array(zs)                       # (B, 17)
-    out = _eval_kernel(jnp.asarray(f), jnp.asarray(z),
-                       jnp.asarray(_roots_limbs(setup)), setup.width)
-    return [int(v) for v in FL.from_mont_array(np.asarray(out))]
+    roots = _roots_limbs(setup)
+    # roots re-upload every call too (jnp.asarray of a host array) —
+    # leaving them out would under-report kzg H2D by a (W, 17) plane.
+    LEDGER.note_transfer("h2d", f.nbytes + z.nbytes + roots.nbytes,
+                         subsystem="kzg")
+    out = _eval_kernel(jnp.asarray(f), jnp.asarray(z),  # device-io: kzg
+                       jnp.asarray(roots), setup.width)
+    host = np.asarray(out)  # device-io: kzg
+    LEDGER.note_transfer("d2h", host.nbytes, subsystem="kzg")
+    return [int(v) for v in FL.from_mont_array(host)]
 
 
 # ---------------------------------------------------------------------------
@@ -167,7 +175,7 @@ def _g2_proj_limbs(points) -> np.ndarray:
     return out
 
 
-def verify_blob_kzg_proof_batch_device(blobs, commitments, proofs,
+def verify_blob_kzg_proof_batch_device(blobs, commitments, proofs,  # device-io: kzg
                                        setup: TrustedSetup) -> bool:
     """B blobs → one device round-trip: eval kernel for the y_i, then 2B
     Miller lanes + shared final exponentiation.  Same accept/reject set as
@@ -210,9 +218,15 @@ def verify_blob_kzg_proof_batch_device(blobs, commitments, proofs,
     g2_lanes[1:2 * B:2] = x2
     mask[:2 * B] = True
     t_prep = time.perf_counter()
-    ok = bool(np.asarray(LP.multi_pairing_is_one(
+    from ..common.device_ledger import LEDGER
+    LEDGER.note_transfer(
+        "h2d", g1_lanes.nbytes + g2_lanes.nbytes + mask.nbytes,
+        subsystem="kzg")
+    ok = bool(np.asarray(LP.multi_pairing_is_one(  # device-io: kzg
         jnp.asarray(g1_lanes), jnp.asarray(g2_lanes), jnp.asarray(mask))))
     t_pair = time.perf_counter()
+    LEDGER.note_transfer("d2h", 1, subsystem="kzg")
+    LEDGER.note_dispatch("kzg", (t_pair - t_prep) * 1e3)
     reset_stage_timings()
     LAST_KZG_TIMINGS.update({
         "blobs": B,

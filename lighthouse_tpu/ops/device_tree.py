@@ -30,35 +30,89 @@ scatter and the re-hash.
 
 from __future__ import annotations
 
+from collections.abc import Mapping
+
 import numpy as np
 
+from ..common.device_ledger import LEDGER
 from .merkle import _next_pow2
 
 # Byte accounting for the residency story (surfaced by bench.py as
 # ``state_root_device_resident``): every host→device transfer made on
 # behalf of device-resident state goes through note_push, every pull of a
-# lazily-materialised host view through note_pull.
-RESIDENCY_STATS: dict = {
-    "bytes_pushed": 0, "bytes_pulled": 0,
-    "scatters": 0, "rebuilds": 0, "materializes": 0,
+# lazily-materialised host view through note_pull.  Since the device
+# ledger landed these route into :data:`~lighthouse_tpu.common.
+# device_ledger.LEDGER` with the caller's ambient subsystem attribution
+# (``device_tree`` when no seam set one), and ``RESIDENCY_STATS`` is a
+# ledger-backed VIEW summing exactly its historical feeders — the
+# tree/registry/packed/fork-choice residency paths.  BLS/KZG/slasher/
+# staging traffic (newly accounted) is visible only through the ledger,
+# so every pre-ledger reader keeps its numbers.
+# Public: the view's feeder set (bench.py and the residency scripts
+# import this — ONE definition, not three drifting copies).
+LEGACY_RESIDENCY_SUBSYSTEMS = ("device_tree", "registry_mirror",
+                               "packed_cache", "fork_choice")
+_LEGACY_SUBSYSTEMS = LEGACY_RESIDENCY_SUBSYSTEMS
+_LEGACY_KEYS = {
+    "bytes_pushed": "h2d_bytes",
+    "bytes_pulled": "d2h_bytes",
+    "scatters": "scatters",
+    "rebuilds": "rebuilds",
+    "materializes": "materializes",
 }
 
 
+class _ResidencyView(Mapping):
+    """Read-only legacy view over the ledger (reset = re-base, so the
+    ledger itself stays monotonic for Prometheus and the per-slot delta
+    ring)."""
+
+    def __init__(self):
+        self._base: dict = {}
+
+    def _totals(self) -> dict:
+        return LEDGER.subsystem_totals(_LEGACY_SUBSYSTEMS)
+
+    def rebase(self) -> None:
+        t = self._totals()
+        self._base = {k: t[lk] for k, lk in _LEGACY_KEYS.items()}
+
+    def __getitem__(self, key: str) -> int:
+        t = self._totals()[_LEGACY_KEYS[key]]
+        return max(int(t - self._base.get(key, 0)), 0)
+
+    def __iter__(self):
+        return iter(_LEGACY_KEYS)
+
+    def __len__(self) -> int:
+        return len(_LEGACY_KEYS)
+
+    def __repr__(self) -> str:
+        return f"ResidencyView({dict(self)})"
+
+
+RESIDENCY_STATS = _ResidencyView()
+
+
 def reset_residency_stats() -> None:
-    for k in RESIDENCY_STATS:
-        RESIDENCY_STATS[k] = 0
+    RESIDENCY_STATS.rebase()
 
 
 def note_push(nbytes: int) -> None:
-    RESIDENCY_STATS["bytes_pushed"] += int(nbytes)
+    LEDGER.note_transfer("h2d", nbytes)
 
 
 def note_pull(nbytes: int) -> None:
-    RESIDENCY_STATS["bytes_pulled"] += int(nbytes)
+    LEDGER.note_transfer("d2h", nbytes)
 
 
 def residency_snapshot() -> dict:
-    return dict(RESIDENCY_STATS)
+    # One totals pass, not one per key (this runs on the traced block-
+    # import path via Tracer.residency_mark/record_residency).
+    t = RESIDENCY_STATS._totals()
+    base = RESIDENCY_STATS._base
+    return {k: max(int(t[lk] - base.get(k, 0)), 0)
+            for k, lk in _LEGACY_KEYS.items()}
 
 
 def _donation_works() -> bool:
@@ -164,11 +218,26 @@ class DeviceTree:
     exactly like the host cache.
     """
 
-    __slots__ = ("levels", "shared")
+    __slots__ = ("levels", "shared", "_res", "__weakref__")
 
     def __init__(self, levels, shared: bool = False):
         self.levels = tuple(levels)
         self.shared = shared
+        # Residency token created lazily at the first accounting seam:
+        # a share() clone holds no token (the parent owns the shared
+        # buffers) until its first mutation lands in fresh buffers.
+        self._res = None
+
+    def note_residency(self) -> None:
+        """Update this tree's HBM-resident byte contribution under the
+        ambient ledger attribution (creates the token + its GC drop
+        seam on first call)."""
+        total = sum(int(lv.nbytes) for lv in self.levels)
+        if self._res is None:
+            self._res = LEDGER.track(
+                self, LEDGER.ambient() or "device_tree", total)
+        else:
+            self._res.set(total)
 
     # -- construction --------------------------------------------------------
 
@@ -181,15 +250,19 @@ class DeviceTree:
         leaves = np.ascontiguousarray(leaves, dtype=np.uint32)
         assert leaves.shape[0] == _next_pow2(leaves.shape[0])
         note_push(leaves.nbytes)
-        RESIDENCY_STATS["materializes"] += 1
-        dev = jax.device_put(leaves)
-        return cls(_get_levels_jit()(dev, use_kernel=_use_kernel()))
+        LEDGER.note_event("materializes")
+        dev = jax.device_put(leaves)  # device-io: device_tree
+        tree = cls(_get_levels_jit()(dev, use_kernel=_use_kernel()))
+        tree.note_residency()
+        return tree
 
     @classmethod
     def from_device_leaves(cls, leaves) -> "DeviceTree":
         """Rebuild from leaves already resident in HBM — zero push."""
-        RESIDENCY_STATS["rebuilds"] += 1
-        return cls(_get_levels_jit()(leaves, use_kernel=_use_kernel()))
+        LEDGER.note_event("rebuilds")
+        tree = cls(_get_levels_jit()(leaves, use_kernel=_use_kernel()))
+        tree.note_residency()
+        return tree
 
     # -- queries -------------------------------------------------------------
 
@@ -198,7 +271,8 @@ class DeviceTree:
         return self.levels[0].shape[0]
 
     def root_words(self) -> np.ndarray:
-        return np.asarray(self.levels[-1])[0]
+        # 32-byte root read: reviewed seam, deliberately unaccounted.
+        return np.asarray(self.levels[-1])[0]  # device-io: device_tree
 
     def pull_levels(self) -> list:
         """Host copies of every level (de-materialization / oracle)."""
@@ -208,7 +282,7 @@ class DeviceTree:
 
     # -- updates -------------------------------------------------------------
 
-    def scatter(self, idx: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    def scatter(self, idx: np.ndarray, rows: np.ndarray) -> np.ndarray:  # device-io: device_tree
         """Warm update: ``rows`` (k, 8) u32 replace leaves at ``idx``
         (ascending, unique); returns the new subtree root words.  H2D is
         the bucket-padded (idx, rows) pair only."""
@@ -218,28 +292,31 @@ class DeviceTree:
         pidx, prows = pad_bucket(np.asarray(idx),
                                  np.ascontiguousarray(rows, dtype=np.uint32))
         note_push(pidx.nbytes + prows.nbytes)
-        RESIDENCY_STATS["scatters"] += 1
+        LEDGER.note_event("scatters")
         jit = _get_scatter_jit(_donation_works() and not self.shared)
-        self.levels = jit(self.levels, jax.device_put(pidx),
+        self.levels = jit(self.levels, jax.device_put(pidx),  # device-io: device_tree
                           jax.device_put(prows))
         self.shared = False  # the update produced buffers only we hold
+        self.note_residency()
         return self.root_words()
 
     def scatter_device(self, idx_dev, rows_dev) -> np.ndarray:
         """Scatter with (idx, rows) already device-resident (registry
         mirror path) — zero push here; the caller accounted its own."""
-        RESIDENCY_STATS["scatters"] += 1
+        LEDGER.note_event("scatters")
         jit = _get_scatter_jit(_donation_works() and not self.shared)
         self.levels = jit(self.levels, idx_dev, rows_dev)
         self.shared = False
+        self.note_residency()
         return self.root_words()
 
     def rebuild_device(self, leaves) -> np.ndarray:
         """Replace every level from device-resident leaves (dirty fraction
         past the walk/rebuild crossover, or width growth) — zero push."""
-        RESIDENCY_STATS["rebuilds"] += 1
+        LEDGER.note_event("rebuilds")
         self.levels = _get_levels_jit()(leaves, use_kernel=_use_kernel())
         self.shared = False
+        self.note_residency()
         return self.root_words()
 
     # -- copy-on-write -------------------------------------------------------

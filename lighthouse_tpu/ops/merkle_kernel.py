@@ -283,9 +283,11 @@ def merkle_levels_device(leaves: np.ndarray, chunk_rows: int | None = None):
     jit = _get_levels_jit()
     use_kernel = _use_pallas()
     if chunk <= 0 or w <= chunk or w % chunk:
-        dev = jax.device_put(leaves)
+        from ..common.device_ledger import LEDGER
+        LEDGER.note_transfer("h2d", leaves.nbytes, subsystem="staging")
+        dev = jax.device_put(leaves)  # device-io: staging
         levels = jit(dev, use_kernel=use_kernel)
-        return np.asarray(levels[-1])[0], levels
+        return np.asarray(levels[-1])[0], levels  # device-io: staging
 
     from ..parallel.pipeline import ChunkStager
 
@@ -301,7 +303,7 @@ def merkle_levels_device(leaves: np.ndarray, chunk_rows: int | None = None):
               for l in range(len(parts[0]))]
     tail = jit(levels[-1], use_kernel=use_kernel)
     levels.extend(tail[1:])
-    root = np.asarray(levels[-1])[0]
+    root = np.asarray(levels[-1])[0]  # device-io: staging
     for key, add in (
             ("builds", 1), ("chunks", n_chunks),
             ("staging_fallbacks", stager.fallbacks),
@@ -333,7 +335,7 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _chunk_roots_natural_impl(leaves: jnp.ndarray, chunk_log2: int,
+def _chunk_roots_natural_impl(leaves: jnp.ndarray, chunk_log2: int,  # device-io: staging
                               use_kernel: bool) -> jnp.ndarray:
     n = leaves.shape[0]
     c = 1 << chunk_log2
@@ -380,8 +382,8 @@ def merkle_root_chunked(leaves, depth: int,
         roots = np.asarray(chunk_roots_natural(
             leaves, chunk_log2=chunk_log2, use_kernel=True))
     else:
-        roots = np.asarray(_chunk_roots_natural_impl(
-            jnp.asarray(leaves), chunk_log2, False))
+        roots = np.asarray(_chunk_roots_natural_impl(  # device-io: staging
+            jnp.asarray(leaves), chunk_log2, False))  # device-io: staging
     # Tail: a few dozen single-hash levels — host dispatch via merkleize_auto
     # (a chain of one-element device launches would be dispatch-bound).
     return merkleize_auto(roots, depth, base_level=chunk_log2)

@@ -98,7 +98,15 @@ def start_level_pull(dev_levels) -> tuple:
     def pull():
         t0 = time.perf_counter()
         try:
-            box.append([np.array(lv) for lv in dev_levels])
+            got = [np.array(lv_dev) for lv_dev in dev_levels]  # device-io: staging
+            box.append(got)
+            # Explicit subsystem (background thread — the caller's
+            # ambient attribution context is thread-local): the whole
+            # tree coming D2H is the ledger's biggest pull path.
+            from ..common.device_ledger import LEDGER
+            LEDGER.note_transfer("d2h",
+                                 sum(lv.nbytes for lv in got),
+                                 subsystem="staging")
         except Exception as e:  # pragma: no cover - tunnel hiccup
             box.append(e)
         observe("merkle_level_pull_seconds", time.perf_counter() - t0)

@@ -73,6 +73,9 @@ def sharded_g1_sum(points: jnp.ndarray, mesh) -> jnp.ndarray:
 
     fn = shard_map(block, mesh=mesh, in_specs=P("batch"), out_specs=P(),
                    check_rep=False)  # the fold is replicated by construction
+    from ..common.device_ledger import LEDGER
+    LEDGER.note_transfer("h2d", int(getattr(points, "nbytes", 0)),
+                         subsystem="bls")
     return jax.jit(fn)(points)
 
 
@@ -200,7 +203,19 @@ def sharded_verify_signature_sets(sets, mesh, rand_fn=None) -> bool:
         h = _pad_rows(h, S_pad, TB._G2_IDENT)
         scal = _pad_rows(scal, S_pad, np.zeros((1, 2), np.uint32))
         smask = _pad_rows(smask, S_pad, np.zeros(1, bool))
-    return bool(_sharded_verify_fn(mesh)(pk, kmask, sig, h, scal, smask))
+    # Transfer accounting (the BLS shard's first): the jit call stages
+    # the marshalled planes implicitly — account them here, where their
+    # sizes are known, plus the 1-byte replicated verdict pull.
+    from ..common.device_ledger import LEDGER
+    LEDGER.note_transfer(
+        "h2d", pk.nbytes + kmask.nbytes + sig.nbytes + h.nbytes
+        + scal.nbytes + smask.nbytes, subsystem="bls")
+    import time
+    t0 = time.perf_counter()
+    ok = bool(_sharded_verify_fn(mesh)(pk, kmask, sig, h, scal, smask))
+    LEDGER.note_dispatch("bls", (time.perf_counter() - t0) * 1e3)
+    LEDGER.note_transfer("d2h", 1, subsystem="bls")
+    return ok
 
 
 def bucketed_verify_signature_sets(sets, mesh, rand_fn=None) -> bool:

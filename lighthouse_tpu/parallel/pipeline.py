@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..common.device_ledger import LEDGER
 from ..common.metrics import observe
 
 
@@ -48,8 +49,18 @@ def _put_arrays(host):
     import numpy as np
 
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x) if isinstance(x, np.ndarray) else x,
+        lambda x: jax.device_put(x) if isinstance(x, np.ndarray) else x,  # device-io: staging
         host)
+
+
+def _tree_nbytes(host) -> int:
+    """Total ndarray bytes in a staged item (the H2D accounting the
+    executors report into the device ledger)."""
+    import jax
+    import numpy as np
+
+    return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(host)
+               if isinstance(leaf, np.ndarray))
 
 
 def _default_stage(host):
@@ -95,9 +106,14 @@ class StagedExecutor:
     """
 
     def __init__(self, name: str = "pipeline",
-                 stage: Optional[Callable] = None):
+                 stage: Optional[Callable] = None,
+                 subsystem: Optional[str] = "staging"):
         self.name = name
         self._stage = stage or _default_stage
+        # Device-ledger attribution of the staged H2D bytes ("bls" for
+        # the verify pipelines, "staging" for cold builds; None = the
+        # caller accounts its own transfers).
+        self.subsystem = subsystem
         self.stats = {
             "items": 0,
             "fallbacks": 0,
@@ -121,6 +137,9 @@ class StagedExecutor:
                 # this marshalling ran under an outstanding device
                 # dispatch — the overlap the double buffering buys
                 self.stats["overlap_prep_s"] += dt
+            if self.subsystem is not None:
+                LEDGER.note_transfer("h2d", _tree_nbytes(host),
+                                     subsystem=self.subsystem)
             t0 = time.perf_counter()
             try:
                 staged = self._stage(host)
@@ -178,9 +197,14 @@ class ChunkStager:
     """
 
     def __init__(self, host_chunks: Sequence[Any],
-                 stage: Optional[Callable] = None, depth: int = 2):
+                 stage: Optional[Callable] = None, depth: int = 2,
+                 subsystem: Optional[str] = "staging"):
         self._chunks = list(host_chunks)
         self._stage = stage or _default_stage
+        # Explicit attribution (the stager thread cannot see the
+        # caller's thread-local ambient context); None = caller
+        # accounted the push itself (the registry-mirror materialize).
+        self.subsystem = subsystem
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._abort = threading.Event()
         self.wait_s = 0.0
@@ -208,6 +232,9 @@ class ChunkStager:
         for i, chunk in enumerate(self._chunks):
             if self._abort.is_set():
                 return
+            if self.subsystem is not None:
+                LEDGER.note_transfer("h2d", _tree_nbytes(chunk),
+                                     subsystem=self.subsystem)
             t0 = time.perf_counter()
             try:
                 dev = self._stage(chunk)

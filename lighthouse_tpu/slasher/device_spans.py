@@ -116,12 +116,18 @@ class DeviceSpanPlane:
     """HBM-resident min/max span planes with fused batched ingest."""
 
     def __init__(self, n_validators: int, history: int = 1024):
+        from ..common.device_ledger import LEDGER
         self.n = n_validators
         self.history = history
         self.min_plane = jnp.full((n_validators, history), _NO_MIN,
                                   jnp.uint16)
         self.max_plane = jnp.full((n_validators, history), _NO_MAX,
                                   jnp.uint16)
+        # Device-side fills — zero H2D, but 2 planes of HBM residency
+        # (the GC finalizer releases them with the plane object).
+        self._res = LEDGER.track(
+            self, "slasher",
+            int(self.min_plane.nbytes) + int(self.max_plane.nbytes))
 
     @staticmethod
     def group(atts: Sequence[Tuple[int, int, np.ndarray]]
@@ -134,7 +140,7 @@ class DeviceSpanPlane:
         return [(s, t, np.unique(np.concatenate(parts)))
                 for (s, t), parts in sorted(by_st.items())]
 
-    def ingest(self, groups: Sequence[Tuple[int, int, np.ndarray]]):
+    def ingest(self, groups: Sequence[Tuple[int, int, np.ndarray]]):  # device-io: slasher
         """Apply grouped updates in fused dispatches of ≤ _MAX_GROUPS.
 
         Returns one dict (s, t) → (min gather, max gather) at the
@@ -153,6 +159,7 @@ class DeviceSpanPlane:
                 raise ValueError(
                     f"span distance {t - s} exceeds the history window "
                     f"{self.history}; clamp upstream")
+        from ..common.device_ledger import LEDGER
         pre: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
         for at in range(0, len(groups), _MAX_GROUPS):
             chunk = groups[at:at + _MAX_GROUPS]
@@ -170,18 +177,31 @@ class DeviceSpanPlane:
                 live[i] = True
                 gidx[i, :len(idx)] = idx
             packed = np.packbits(masks, axis=1, bitorder="little")
-            self.min_plane, self.max_plane, g_min, g_max = _ingest_kernel(
+            LEDGER.note_transfer(
+                "h2d", packed.nbytes + sources.nbytes + targets.nbytes
+                + live.nbytes + gidx.nbytes, subsystem="slasher")
+            t0 = time.perf_counter()
+            self.min_plane, self.max_plane, g_min, g_max = _ingest_kernel(  # device-io: slasher
                 self.min_plane, self.max_plane, jnp.asarray(packed),
                 jnp.asarray(sources), jnp.asarray(targets),
                 jnp.asarray(live), jnp.asarray(gidx))
-            g_min = np.asarray(g_min)
-            g_max = np.asarray(g_max)
+            g_min = np.asarray(g_min)   # device-io: slasher
+            g_max = np.asarray(g_max)   # device-io: slasher
+            LEDGER.note_dispatch("slasher",
+                                 (time.perf_counter() - t0) * 1e3)
+            LEDGER.note_transfer("d2h", g_min.nbytes + g_max.nbytes,
+                                 subsystem="slasher")
             for i, (s, t, idx) in enumerate(chunk):
                 pre[(s, t)] = (g_min[i, :len(idx)], g_max[i, :len(idx)])
         return pre
 
     def to_host(self) -> Tuple[np.ndarray, np.ndarray]:
-        return np.asarray(self.min_plane), np.asarray(self.max_plane)
+        from ..common.device_ledger import LEDGER
+        mn = np.asarray(self.min_plane)  # device-io: slasher
+        mx = np.asarray(self.max_plane)  # device-io: slasher
+        LEDGER.note_transfer("d2h", mn.nbytes + mx.nbytes,
+                             subsystem="slasher")
+        return mn, mx
 
 
 def bench_device_span_update(n_validators: int, history: int,

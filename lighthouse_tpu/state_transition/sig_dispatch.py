@@ -214,6 +214,10 @@ class BlockSigDispatcher:
             return bool(ok), path
         backend = bls.get_backend()
         if getattr(backend, "name", "") != "tpu":
+            # Direct host-backend verify: deliberately NOT a ledger
+            # dispatch — the ledger answers "what ran on the device",
+            # and a python/fake verify never touched one (same rule as
+            # the envelope's host-fallback path).
             return (bool(backend.verify_signature_sets(sets)),
                     getattr(backend, "name", "host"))
         from ..beacon_chain.verification_service import block_sig_dispatch

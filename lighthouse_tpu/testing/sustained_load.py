@@ -123,6 +123,17 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
     was_enabled = TRACER.enabled
     prev_ring = TRACER.max_slots
     ring_needed = slots + warmup_slots + max_tail_slots + 4
+    # The ledger's slot-delta ring must also hold the WHOLE run: the
+    # budget check walks every measured slot, and a default-sized (64)
+    # ring would silently evict the early slots of a long drill —
+    # a violation there would never be seen.
+    from ..common.device_ledger import LEDGER
+    prev_ledger_slots = LEDGER.max_slots
+    LEDGER.max_slots = max(prev_ledger_slots, ring_needed)
+    # Drills restart slot numbering at genesis: a previous run's ring
+    # entries under the SAME slot numbers would be evaluated against
+    # this run's budget — start from an empty ring.
+    LEDGER.clear_slot_ring()
     if not was_enabled:
         TRACER.reset()
         TRACER.enable(ring=max(ring_needed, prev_ring))
@@ -336,9 +347,21 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
 
         final = engine.evaluate()
         st = svc.stats()
+        # Warm-slot transfer budget (device ledger): close the open
+        # ledger slot, then check every MEASURED slot's per-subsystem
+        # transfer deltas against the declarative budget — "the hot
+        # path went host-roundtrip-shaped" must fail the drill, not
+        # hide as a silent 2x regression.  Exported as an SLO-style
+        # attainment row next to the engine's objectives.
+        from ..common.device_ledger import evaluate_budget
+        LEDGER.mark_slot(last + max_tail_slots + 1000)
+        measured_deltas = [d for d in LEDGER.slot_deltas()
+                           if first <= d["slot"] <= last]
+        budget_eval = evaluate_budget(measured_deltas)
         attainments = {
             row["name"]: row["slow"].get("attainment")
             for row in final["objectives"]}
+        attainments["device_transfer_budget"] = budget_eval["attainment"]
         zero_loss = (not missing and st["rejected"] == 0
                      and st["shed"] == 0
                      and st["verified"] == st["submitted"])
@@ -383,6 +406,14 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
             "breaker": st["bls"]["breaker"],
             "per_slot": per_slot,
             "trace_slots": TRACER.slot_summaries(),
+            "device_budget": {
+                "slots_checked": budget_eval["slots_checked"],
+                "attainment": budget_eval["attainment"],
+                "ok": budget_eval["ok"],
+                "violations": [r for r in budget_eval["rows"]
+                               if not r["ok"]],
+                "ledger": LEDGER.snapshot()["subsystems"],
+            },
         }
         if inj is not None:
             burned = set()
@@ -404,6 +435,7 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
     finally:
         if node is not None:
             node.close()
+        LEDGER.max_slots = prev_ledger_slots
         TRACER.max_slots = prev_ring
         if was_enabled:
             TRACER.enable()

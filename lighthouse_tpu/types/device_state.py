@@ -36,8 +36,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..ops.device_tree import (DeviceTree, note_pull, note_push,
-                               residency_snapshot)
+from ..common.device_ledger import LEDGER
+from ..ops.device_tree import DeviceTree, note_push, residency_snapshot
 from ..ops.merkle import _next_pow2
 from ..ops.tree_cache import fold_zero_cap
 
@@ -116,8 +116,8 @@ class DeviceColumn:
     # -- host/device plumbing ------------------------------------------------
 
     def _pull(self) -> None:
-        host = np.asarray(self._dev)
-        note_pull(host.nbytes)
+        host = np.asarray(self._dev)  # device-io: packed_cache
+        LEDGER.note_transfer("d2h", host.nbytes, subsystem="packed_cache")
         object.__setattr__(self, "_host", host.copy()
                            if not host.flags.writeable else host)
         object.__setattr__(self, "_stale", False)
@@ -365,8 +365,9 @@ class DevicePackedCache:
         paid only when host-side mutation resumes — which implies the host
         needed the values anyway)."""
         if self.src is None and self.src_dev is not None:
-            self.src = np.asarray(self.src_dev).copy()
-            note_pull(self.src.nbytes)
+            self.src = np.asarray(self.src_dev).copy()  # device-io: packed_cache
+            LEDGER.note_transfer("d2h", self.src.nbytes,
+                                 subsystem="packed_cache")
             self.src_dev = None
 
     def _host_rebuild(self, host: np.ndarray, w: int) -> np.ndarray:
@@ -379,7 +380,7 @@ class DevicePackedCache:
         else:
             note_push(leaves.nbytes)
             import jax
-            self.tree.rebuild_device(jax.device_put(leaves))
+            self.tree.rebuild_device(jax.device_put(leaves))  # device-io: packed_cache
         self.src = host.copy()
         self.src_dev = None
         return self.tree.root_words()
@@ -387,6 +388,12 @@ class DevicePackedCache:
     # -- the per-root entry point -------------------------------------------
 
     def root(self, col) -> bytes:
+        # Every transfer/compile under this root — including the nested
+        # DeviceTree pushes — attributes to the packed-column cache.
+        with LEDGER.attribute("packed_cache"):
+            return self._root_inner(col)
+
+    def _root_inner(self, col) -> bytes:
         if isinstance(col, DeviceColumn):
             state, payload = col.consume()
         else:  # untracked plain column (a path the interception missed)
@@ -402,15 +409,13 @@ class DevicePackedCache:
                     and self.tree.width == w):
                 return self._fold(self.tree.root_words(), w, n)
             levels = _repack_rebuild(payload, w)
+            LEDGER.note_event("rebuilds")
             if self.tree is None:
                 self.tree = DeviceTree(levels)
-                from ..ops.device_tree import RESIDENCY_STATS
-                RESIDENCY_STATS["rebuilds"] += 1
             else:
-                from ..ops.device_tree import RESIDENCY_STATS
-                RESIDENCY_STATS["rebuilds"] += 1
                 self.tree.levels = levels
                 self.tree.shared = False
+            self.tree.note_residency()
             self.src = None
             self.src_dev = payload
             return self._fold(self.tree.root_words(), w, n)

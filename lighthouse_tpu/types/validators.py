@@ -581,16 +581,21 @@ def registry_cold_device(reg: "ValidatorRegistry",
     use_kernel = _use_pallas()
     chunk = _reg_chunk_rows() if chunk_rows is None else chunk_rows
     if chunk <= 0 or m <= chunk or chunk % _PALLAS_PAD:
+        from ..common.device_ledger import LEDGER
         t0 = time.perf_counter()
-        cols = {k: jax.device_put(v)
-                for k, v in _registry_raw_columns(reg, m).items()}
+        host_cols = _registry_raw_columns(reg, m)
+        LEDGER.note_transfer(
+            "h2d", sum(v.nbytes for v in host_cols.values()),
+            subsystem="staging")
+        cols = {k: jax.device_put(v)  # device-io: staging
+                for k, v in host_cols.items()}
         jax.block_until_ready(cols)
         t1 = time.perf_counter()
         if _levels_jit is None:
             _levels_jit = jax.jit(_registry_levels_body,
                                   static_argnames=("n", "w", "use_kernel"))
         levels = _levels_jit(cols, n=n, w=w, use_kernel=use_kernel)
-        root_words = np.asarray(levels[-1])[0]
+        root_words = np.asarray(levels[-1])[0]  # device-io: staging
         t2 = time.perf_counter()
         LAST_COLD_TIMINGS.update(
             push_ms=round((t1 - t0) * 1e3, 1),
@@ -604,7 +609,7 @@ def registry_cold_device(reg: "ValidatorRegistry",
     host = _registry_raw_columns(reg, m)
     chunks = [{k: v[b:b + chunk] for k, v in host.items()}
               for b in range(0, m, chunk)]
-    stager = ChunkStager(chunks)
+    stager = ChunkStager(chunks, subsystem="staging")
     if _record_roots_jit is None:
         _record_roots_jit = jax.jit(_record_roots_body,
                                     static_argnames=("use_kernel",))
@@ -614,7 +619,7 @@ def registry_cold_device(reg: "ValidatorRegistry",
             for dev in stager]
     rec = recs[0] if len(recs) == 1 else jnp.concatenate(recs, axis=0)
     levels = _levels_combine_jit(rec, n=n, w=w, use_kernel=use_kernel)
-    root_words = np.asarray(levels[-1])[0]
+    root_words = np.asarray(levels[-1])[0]  # device-io: staging
     wall = time.perf_counter() - t0
     LAST_COLD_TIMINGS.update(
         push_ms=round(stager.wait_s * 1e3, 1),
@@ -639,23 +644,24 @@ def registry_cold_device(reg: "ValidatorRegistry",
 def registry_device_columns(reg: "ValidatorRegistry") -> dict:
     """Push the registry columns to the device once (HBM residency)."""
     import jax
+    from ..common.device_ledger import LEDGER
     n = reg._n
-    return {
-        "pubkey": jax.device_put(bytes_col_to_words(reg._pubkey[:n])),
-        "withdrawal_credentials": jax.device_put(
-            bytes_col_to_words(reg._withdrawal_credentials[:n])),
-        "effective_balance": jax.device_put(
-            u64_to_chunk_words(reg._effective_balance[:n])),
-        "slashed": jax.device_put(
-            u64_to_chunk_words(reg._slashed[:n].astype(np.uint64))),
-        "activation_eligibility_epoch": jax.device_put(
-            u64_to_chunk_words(reg._activation_eligibility_epoch[:n])),
-        "activation_epoch": jax.device_put(
-            u64_to_chunk_words(reg._activation_epoch[:n])),
-        "exit_epoch": jax.device_put(u64_to_chunk_words(reg._exit_epoch[:n])),
-        "withdrawable_epoch": jax.device_put(
-            u64_to_chunk_words(reg._withdrawable_epoch[:n])),
+    host = {
+        "pubkey": bytes_col_to_words(reg._pubkey[:n]),
+        "withdrawal_credentials":
+            bytes_col_to_words(reg._withdrawal_credentials[:n]),
+        "effective_balance": u64_to_chunk_words(reg._effective_balance[:n]),
+        "slashed": u64_to_chunk_words(reg._slashed[:n].astype(np.uint64)),
+        "activation_eligibility_epoch":
+            u64_to_chunk_words(reg._activation_eligibility_epoch[:n]),
+        "activation_epoch": u64_to_chunk_words(reg._activation_epoch[:n]),
+        "exit_epoch": u64_to_chunk_words(reg._exit_epoch[:n]),
+        "withdrawable_epoch":
+            u64_to_chunk_words(reg._withdrawable_epoch[:n]),
     }
+    LEDGER.note_transfer("h2d", sum(v.nbytes for v in host.values()),
+                         subsystem="staging")
+    return {k: jax.device_put(v) for k, v in host.items()}  # device-io: staging
 
 
 def _registry_root_fused(cols: dict, *, depth: int, chunk_log2: int,
@@ -699,7 +705,7 @@ def _registry_root_fused(cols: dict, *, depth: int, chunk_log2: int,
     # record-root level, so cap siblings are record-level zero hashes —
     # expansion level ℓ pairs with ZERO_HASHES[ℓ − 3].
     while lvl < depth + 3:
-        root = hash64(root, jnp.asarray(ZERO_HASHES[lvl - 3]))
+        root = hash64(root, jnp.asarray(ZERO_HASHES[lvl - 3]))  # device-io: registry_mirror
         lvl += 1
     return root
 
@@ -835,16 +841,29 @@ class DeviceRegistryMirror:
     """HBM-resident raw columns + record-root tree for one registry
     lineage (COW across :meth:`ValidatorRegistry.copy`)."""
 
-    __slots__ = ("cols", "tree", "shared")
+    __slots__ = ("cols", "tree", "shared", "_res", "__weakref__")
 
     def __init__(self, cols: dict, tree, shared: bool = False):
         self.cols = cols
         self.tree = tree
         self.shared = shared
+        self._res = None
 
     @property
     def width(self) -> int:
         return self.cols["slashed"].shape[0]
+
+    def note_residency(self) -> None:
+        """Ledger watermark seam: this mirror's HBM columns + record
+        tree (a share() clone counts nothing until it diverges — the
+        parent owns the shared buffers)."""
+        from ..common.device_ledger import LEDGER
+        total = sum(int(v.nbytes) for v in self.cols.values()) \
+            + sum(int(lv.nbytes) for lv in self.tree.levels)
+        if self._res is None:
+            self._res = LEDGER.track(self, "registry_mirror", total)
+        else:
+            self._res.set(total)
 
     @classmethod
     def materialize(cls, reg: "ValidatorRegistry") -> "DeviceRegistryMirror":
@@ -853,54 +872,62 @@ class DeviceRegistryMirror:
         push this lineage ever makes."""
         import jax
         import jax.numpy as jnp
-        from ..ops.device_tree import (DeviceTree, RESIDENCY_STATS,
-                                       note_push)
+        from ..common.device_ledger import LEDGER
+        from ..ops.device_tree import DeviceTree, note_push
         from ..ops.merkle import _next_pow2
         from ..ops.merkle_kernel import _use_pallas
 
         n = reg._n
         w = _next_pow2(max(n, 1))
-        host = _registry_raw_columns(reg, w)
-        note_push(sum(v.nbytes for v in host.values()))
-        RESIDENCY_STATS["materializes"] += 1
-        chunk = _reg_chunk_rows()
-        if chunk > 0 and w > chunk and w % chunk == 0:
-            from ..parallel.pipeline import ChunkStager
-            chunks = [{k: v[b:b + chunk] for k, v in host.items()}
-                      for b in range(0, w, chunk)]
-            parts = list(ChunkStager(chunks))
-            cols = {k: jnp.concatenate([p[k] for p in parts], axis=0)
-                    for k in host}
-        else:
-            cols = {k: jax.device_put(v) for k, v in host.items()}
-        levels = _get_mirror_rebuild_jit()(
-            cols, np.uint32(n), use_kernel=_use_pallas())
-        from ..ops.tree_cache import HASH_COUNT
-        HASH_COUNT[0] += 8 * w + (w - 1)
-        return cls(cols, DeviceTree(levels), False)
+        with LEDGER.attribute("registry_mirror"):
+            host = _registry_raw_columns(reg, w)
+            note_push(sum(v.nbytes for v in host.values()))
+            LEDGER.note_event("materializes")
+            chunk = _reg_chunk_rows()
+            if chunk > 0 and w > chunk and w % chunk == 0:
+                from ..parallel.pipeline import ChunkStager
+                chunks = [{k: v[b:b + chunk] for k, v in host.items()}
+                          for b in range(0, w, chunk)]
+                # subsystem=None: the full-width push is accounted once
+                # above — the stager must not double-count it.
+                parts = list(ChunkStager(chunks, subsystem=None))
+                cols = {k: jnp.concatenate([p[k] for p in parts], axis=0)
+                        for k in host}
+            else:
+                cols = {k: jax.device_put(v) for k, v in host.items()}  # device-io: registry_mirror
+            levels = _get_mirror_rebuild_jit()(
+                cols, np.uint32(n), use_kernel=_use_pallas())
+            from ..ops.tree_cache import HASH_COUNT
+            HASH_COUNT[0] += 8 * w + (w - 1)
+            mirror = cls(cols, DeviceTree(levels), False)
+            mirror.note_residency()
+            return mirror
 
-    def scatter_records(self, reg: "ValidatorRegistry",
+    def scatter_records(self, reg: "ValidatorRegistry",  # device-io: registry_mirror
                         idx: np.ndarray) -> np.ndarray:
         """Land ``idx`` dirty records as one fused device dispatch; returns
         the new subtree root words.  H2D = the bucket-padded raw rows."""
         import jax
-        from ..ops.device_tree import (RESIDENCY_STATS, _donation_works,
-                                       note_push)
+        from ..common.device_ledger import LEDGER
+        from ..ops.device_tree import _donation_works, note_push
         from ..ops.tree_cache import HASH_COUNT
 
-        pidx, rows = _pad_rows_bucket(np.asarray(idx),
-                                      _registry_raw_rows(reg, idx))
-        note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
-        RESIDENCY_STATS["scatters"] += 1
-        HASH_COUNT[0] += pidx.shape[0] * (8 + len(self.tree.levels) - 1)
-        jit = _get_mirror_scatter_jit(
-            _donation_works() and not self.shared and not self.tree.shared)
-        self.cols, self.tree.levels = jit(
-            self.tree.levels, self.cols, jax.device_put(pidx),
-            {k: jax.device_put(v) for k, v in rows.items()})
-        self.shared = False
-        self.tree.shared = False
-        return self.tree.root_words()
+        with LEDGER.attribute("registry_mirror"):
+            pidx, rows = _pad_rows_bucket(np.asarray(idx),
+                                          _registry_raw_rows(reg, idx))
+            note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
+            LEDGER.note_event("scatters")
+            HASH_COUNT[0] += pidx.shape[0] * (8 + len(self.tree.levels) - 1)
+            jit = _get_mirror_scatter_jit(
+                _donation_works() and not self.shared
+                and not self.tree.shared)
+            self.cols, self.tree.levels = jit(
+                self.tree.levels, self.cols, jax.device_put(pidx),  # device-io: registry_mirror
+                {k: jax.device_put(v) for k, v in rows.items()})
+            self.shared = False
+            self.tree.shared = False
+            self.note_residency()
+            return self.tree.root_words()
 
     def scatter_cols(self, reg: "ValidatorRegistry",
                      idx: np.ndarray) -> None:
@@ -908,29 +935,32 @@ class DeviceRegistryMirror:
         the prelude to :meth:`rebuild` when the dirty fraction or a width
         change makes path-walking the wrong tool."""
         import jax
+        from ..common.device_ledger import LEDGER
         from ..ops.device_tree import note_push
 
-        pidx, rows = _pad_rows_bucket(np.asarray(idx),
-                                      _registry_raw_rows(reg, idx))
-        note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
-        idx_dev = jax.device_put(pidx)
-        for k in self.cols:
-            self.cols[k] = self.cols[k].at[idx_dev].set(
-                jax.device_put(rows[k]))
-        self.shared = False
+        with LEDGER.attribute("registry_mirror"):
+            pidx, rows = _pad_rows_bucket(np.asarray(idx),
+                                          _registry_raw_rows(reg, idx))
+            note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
+            idx_dev = jax.device_put(pidx)  # device-io: registry_mirror
+            for k in self.cols:
+                self.cols[k] = self.cols[k].at[idx_dev].set(
+                    jax.device_put(rows[k]))  # device-io: registry_mirror
+            self.shared = False
 
     def rebuild(self, n: int) -> np.ndarray:
         """Re-reduce every level from the HBM columns — zero push."""
-        from ..ops.device_tree import RESIDENCY_STATS
+        from ..common.device_ledger import LEDGER
         from ..ops.merkle_kernel import _use_pallas
         from ..ops.tree_cache import HASH_COUNT
 
-        RESIDENCY_STATS["rebuilds"] += 1
+        LEDGER.note_event("rebuilds", subsystem="registry_mirror")
         w = self.width
         HASH_COUNT[0] += 8 * w + (w - 1)
         self.tree.levels = _get_mirror_rebuild_jit()(
             self.cols, np.uint32(n), use_kernel=_use_pallas())
         self.tree.shared = False
+        self.note_residency()
         return self.tree.root_words()
 
     def ensure_width(self, new_w: int) -> bool:
@@ -945,6 +975,7 @@ class DeviceRegistryMirror:
             pad = jnp.zeros((new_w - w,) + v.shape[1:], dtype=v.dtype)
             self.cols[k] = jnp.concatenate([v, pad], axis=0)
         self.shared = False  # concat produced buffers only we hold
+        self.note_residency()
         return True
 
     def share(self) -> "DeviceRegistryMirror":

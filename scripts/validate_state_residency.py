@@ -116,15 +116,34 @@ def _mutate_round(rng: np.random.Generator, state, k: int) -> None:
              np.array([32 * 10 ** 9], dtype=np.uint64)])
 
 
+# The residency subsystems (device-ledger attribution; ISSUE 15 —
+# residency is read through the LEDGER snapshot, not
+# ops.device_tree.residency_snapshot()).  One shared definition with
+# the legacy view.
+
+
+def _subs():
+    from lighthouse_tpu.ops.device_tree import (
+        LEGACY_RESIDENCY_SUBSYSTEMS)
+    return LEGACY_RESIDENCY_SUBSYSTEMS
+
+
+def _ledger_snapshot():
+    from lighthouse_tpu.common.device_ledger import LEDGER
+    return LEDGER.snapshot()["subsystems"]
+
+
+def _pushed(snap) -> int:
+    return sum(snap[s]["h2d_bytes"] for s in _subs())
+
+
 def validate(n: int, mutations: int, seed: int, copy_every: int) -> int:
     from lighthouse_tpu.common import tracing
-    from lighthouse_tpu.ops.device_tree import (reset_residency_stats,
-                                                residency_snapshot)
     from lighthouse_tpu.types.device_state import materialize_state
 
     host = _mk_state(n, seed)
     dev = _mk_state(n, seed)
-    reset_residency_stats()
+    base = _ledger_snapshot()
     t0 = time.perf_counter()
     if not materialize_state(dev):
         print("materialize_state declined (LIGHTHOUSE_TPU_DEVICE_STATE=0?)")
@@ -152,11 +171,11 @@ def validate(n: int, mutations: int, seed: int, copy_every: int) -> int:
                 print(f"round {m}: COW LEAK into parent")
                 failures += 1
             host, dev = host2, dev2
-        before = residency_snapshot()
+        before = _pushed(_ledger_snapshot())
         t0 = time.perf_counter()
         r_dev = dev.tree_hash_root()
         dev_ms = (time.perf_counter() - t0) * 1e3
-        pushed = residency_snapshot()["bytes_pushed"] - before["bytes_pushed"]
+        pushed = _pushed(_ledger_snapshot()) - before
         t0 = time.perf_counter()
         r_host = host.tree_hash_root()
         host_ms = (time.perf_counter() - t0) * 1e3
@@ -166,11 +185,22 @@ def validate(n: int, mutations: int, seed: int, copy_every: int) -> int:
         if r_dev != r_host:
             failures += 1
             break
-    stats = residency_snapshot()
-    print(f"totals: {stats['bytes_pushed']} B pushed, "
-          f"{stats['bytes_pulled']} B pulled, {stats['scatters']} scatters, "
-          f"{stats['rebuilds']} rebuilds, "
-          f"{stats['materializes']} materializes")
+    snap = _ledger_snapshot()
+
+    def tot(key: str) -> int:
+        return sum(snap[s][key] - base[s][key] for s in _subs())
+
+    print(f"totals: {tot('h2d_bytes')} B pushed, "
+          f"{tot('d2h_bytes')} B pulled, {tot('scatters')} scatters, "
+          f"{tot('rebuilds')} rebuilds, "
+          f"{tot('materializes')} materializes")
+    print("per-subsystem ledger:")
+    for s in _subs():
+        row = snap[s]
+        print(f"  {s:16s} h2d={row['h2d_bytes'] - base[s]['h2d_bytes']} B "
+              f"d2h={row['d2h_bytes'] - base[s]['d2h_bytes']} B "
+              f"resident={row['resident_bytes']} B "
+              f"high_water={row['hbm_high_water_bytes']} B")
     return failures
 
 

@@ -14,6 +14,9 @@ on any violated invariant:
   box overload, reported distinctly from loss: such a slot's loss
   check cannot certify either way, so the run is not green)
 - a declared objective with no computed attainment (a dead feed)
+- a warm-slot device-transfer budget violation (the device ledger's
+  per-slot per-subsystem byte deltas against ``WARM_SLOT_BUDGET`` —
+  a full-column host round-trip inside a measured slot fails the run)
 - an UNEXPLAINED SLO violation: without ``--faults`` the health state
   must never leave ``healthy``; with ``--faults`` (a device outage
   injected for a slot window) the state must walk degraded → healthy
@@ -114,6 +117,16 @@ def main(argv=None) -> int:
         dead = [k for k, v in board["attainment"].items() if v is None]
         failures.append(f"objectives with no attainment (dead feed?): "
                         f"{dead}")
+    if not board["device_budget"]["ok"]:
+        # Warm-slot transfer budget (device ledger): a subsystem moved
+        # more bytes in a measured slot than residency allows — the hot
+        # path went host-roundtrip-shaped.
+        viol = [f"{r['subsystem']}/{r['direction']}: "
+                f"{r['worst_slot_bytes']} B > {r['budget_bytes']} B "
+                f"(slot {r['worst_slot']})"
+                for r in board["device_budget"]["violations"]]
+        failures.append("warm-slot transfer budget violated: "
+                        + "; ".join(viol))
     transitions = board["health"]["transitions"]
     if not args.faults:
         if transitions or board["health"]["state"] != "healthy":
@@ -151,6 +164,8 @@ def main(argv=None) -> int:
         "transitions": [(t["from"], t["to"], t["reasons"])
                         for t in transitions],
         "host_fallbacks": board["host_fallbacks"],
+        "device_budget_ok": board["device_budget"]["ok"],
+        "device_budget_attainment": board["device_budget"]["attainment"],
         "artifact": args.out,
         "failures": failures,
     }

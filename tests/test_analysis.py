@@ -493,6 +493,82 @@ def test_stage_checker_exemption_is_per_dict_not_per_file():
     assert details(found) == ["unregistered:LAST_FORGOTTEN_TIMINGS"]
 
 
+# -- device-accounting --
+
+
+def test_device_checker_flags_unannotated_primitives():
+    found = run_checker("device-accounting", {"lighthouse_tpu/x.py": """
+        import jax
+        import numpy as np
+
+        def push(arr):
+            return jax.device_put(arr)
+
+        def pull(self):
+            return np.asarray(self._dev)
+
+        def pull_copy(self):
+            return [np.array(lv_dev) for lv_dev in self.levels]
+    """})
+    assert details(found) == ["unannotated:device_put",
+                              "unannotated:np.asarray(device_array)",
+                              "unannotated:np.asarray(device_array)"]
+
+
+def test_device_checker_annotated_seams_pass():
+    found = run_checker("device-accounting", {"lighthouse_tpu/x.py": """
+        import jax
+        import numpy as np
+
+        def push(arr):  # device-io: staging
+            return jax.device_put(arr)
+
+        def pull(self):
+            host = np.asarray(self._dev)  # device-io: packed_cache
+            return host
+
+        def host_only(arr):
+            return np.asarray(arr)  # plain host conversion: not flagged
+    """})
+    assert found == []
+
+
+def test_device_checker_rejects_unknown_subsystem():
+    found = run_checker("device-accounting", {"lighthouse_tpu/x.py": """
+        import jax
+
+        def push(arr):  # device-io: warp_drive
+            return jax.device_put(arr)
+    """})
+    assert details(found) == ["bad-subsystem:warp_drive"]
+
+
+def test_device_checker_jnp_asarray_scoped_to_device_modules():
+    src = """
+        import jax.numpy as jnp
+
+        def stage(x):
+            return jnp.asarray(x)
+    """
+    # crypto/kernel modules: jnp.asarray is trace-time constant
+    # material, not a runtime transfer — out of scope
+    assert run_checker("device-accounting",
+                       {"lighthouse_tpu/crypto/limb_field.py": src}) == []
+    found = run_checker(
+        "device-accounting",
+        {"lighthouse_tpu/slasher/device_spans.py": src})
+    assert details(found) == ["unannotated:jnp.asarray"]
+
+
+def test_device_checker_skips_outside_package():
+    found = run_checker("device-accounting", {"scripts/x.py": """
+        import jax
+        def push(arr):
+            return jax.device_put(arr)
+    """})
+    assert found == []
+
+
 # ---------------------------------------------------------------------------
 # Baseline round-trip
 # ---------------------------------------------------------------------------
