@@ -1066,6 +1066,116 @@ def _sustained_slo_bench() -> dict:
     return out
 
 
+def _proof_engine_bench() -> dict:
+    """Device Merkle-branch extraction (ISSUE 17): batched gather of
+    proof branches from a resident 2^21-leaf DeviceTree at 1/64/1024
+    concurrent gindices — zero re-hashing, one device program per batch
+    — vs the host-walk oracle (one full hashlib rebuild, the
+    `merkle_proof.MerkleTree._levels` shape) and the cached-levels host
+    branch-assembly rate.  A sample branch is verified against the
+    device root before any number is believed."""
+    import numpy as np
+
+    from lighthouse_tpu.ops.device_tree import DeviceTree
+    from lighthouse_tpu.ops.merkle_proof import verify_merkle_proof
+    from lighthouse_tpu.ops.proof_engine import DeviceProofEngine
+    from lighthouse_tpu.ops.sha256 import words_to_bytes
+
+    log2 = 21
+    n = 1 << log2
+    rng = np.random.default_rng(7)
+    leaves = rng.integers(0, 1 << 32, size=(n, 8),
+                          dtype=np.uint64).astype(np.uint32)
+    t0 = time.perf_counter()
+    tree = DeviceTree.from_host_leaves(leaves)
+    build_ms = (time.perf_counter() - t0) * 1e3
+    eng = DeviceProofEngine(tree)
+    root = words_to_bytes(tree.root_words())
+
+    out: dict = {"proof_tree_log2_leaves": log2,
+                 "proof_tree_build_ms": round(build_ms, 1)}
+    for batch in (1, 64, 1024):
+        # Deterministic leaf gindices spread across the width.
+        gs = [n + (i * 2_097_143) % n for i in range(batch)]
+        eng.branches(gs)  # warm the gather jit for this batch shape
+        best = min(_time_one(lambda: eng.branches(gs))
+                   for _ in range(5 if batch < 1024 else 3))
+        out[f"proof_extract_batch_{batch}_per_s"] = round(batch / best, 1)
+    # Correctness gate: one device branch must verify against the
+    # device root (and it did NOT come from any hash on the way out).
+    g = n + 12345
+    branch = eng.branches([g])[g]
+    leaf = leaves[12345].astype(">u4").tobytes()
+    assert verify_merkle_proof(leaf, branch, log2, 12345, root), \
+        "device branch failed verification against device root"
+    # Host-walk oracle: the per-request shape the engine replaces — a
+    # full levels rebuild (what MerkleTree.proof pays at this width) is
+    # ~2^22 hashes, so walk a 2^14-leaf slice and scale (the walk is
+    # linear in width by construction) — plus the cached-levels host
+    # branch-assembly rate.
+    import hashlib
+    slice_log2 = 14
+    lv = [leaves[i].astype(">u4").tobytes()
+          for i in range(1 << slice_log2)]
+    t0 = time.perf_counter()
+    host_levels = [lv]
+    while len(lv) > 1:
+        lv = [hashlib.sha256(lv[i] + lv[i + 1]).digest()
+              for i in range(0, len(lv), 2)]
+        host_levels.append(lv)
+    slice_ms = (time.perf_counter() - t0) * 1e3
+    out["proof_extract_host_walk_ms"] = round(
+        slice_ms * (n / (1 << slice_log2)), 1)
+
+    def host_branch(i: int) -> list:
+        return [host_levels[d][(i >> d) ^ 1] for d in range(slice_log2)]
+
+    best = min(_time_one(lambda: [host_branch(i % (1 << slice_log2))
+                                  for i in range(1024)])
+               for _ in range(5))
+    out["proof_extract_host_cached_per_s"] = round(1024 / best, 1)
+    return out
+
+
+def _lc_bootstrap_bench() -> dict:
+    """Light-client bootstrap latency (ISSUE 17): the re-homed
+    `LightClientServer.bootstrap` — header + current sync committee +
+    the device-extracted `current_sync_committee_branch` — over a warm
+    proof server, vs the host `state_field_proof` walk it replaced."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.light_client import (LightClientServer,
+                                             state_field_proof)
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    h = StateHarness(n_validators=64, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(
+        store=HotColdDB.memory(h.preset, h.spec, h.T),
+        genesis_state=h.state.copy(),
+        genesis_block_root=hdr.tree_hash_root(),
+        preset=h.preset, spec=h.spec, T=h.T)
+    srv = LightClientServer(chain)
+    srv.bootstrap()  # warm: field tree materialize + gather jit
+    best = min(_time_one(srv.bootstrap) for _ in range(20))
+    state = chain.head.state
+    host_best = min(_time_one(lambda: state_field_proof(
+        state, "current_sync_committee")) for _ in range(20))
+    return {
+        "light_client_bootstrap_ms": round(best * 1e3, 3),
+        "light_client_host_branch_ms": round(host_best * 1e3, 3),
+        "light_client_proof_stats": chain.proof_server.stats(),
+    }
+
+
+def _time_one(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def _stage_split_bench() -> dict:
     """VERDICT r4 #2: the measured per-stage decomposition of the fused
     pipeline (marshal/hash/prepare/Miller/fold/finalize) — at the r5
@@ -1327,6 +1437,9 @@ _ROWS = [
     ("stream", _stream_verify_bench, "stream_verify", False),
     ("sustained", _sustained_slo_bench, "sustained_slo", False),
     ("restart", _restart_recovery_bench, "restart_recovery", False),
+    ("lc_bootstrap", _lc_bootstrap_bench, "light_client_bootstrap",
+     False),
+    ("proof", _proof_engine_bench, "proof_extract_batch", True),
     ("registry", _registry_htr_bench, "registry_htr_2e%d" % REG_LOG2,
      True),
     ("state_root", _incremental_state_root_bench,

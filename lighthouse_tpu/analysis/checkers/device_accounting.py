@@ -51,6 +51,7 @@ PACKAGE = "lighthouse_tpu/"
 # subsystems themselves), not trace-time constants.
 DEVICE_MODULES = frozenset({
     "lighthouse_tpu/ops/device_tree.py",
+    "lighthouse_tpu/ops/proof_engine.py",
     "lighthouse_tpu/ops/merkle_kernel.py",
     "lighthouse_tpu/types/device_state.py",
     "lighthouse_tpu/types/validators.py",

@@ -192,6 +192,46 @@ class HttpApiServer:
                 h._json({"data": out,
                          "execution_optimistic": False,
                          "finalized": False})
+            elif parts[6] == "proof":
+                # Generalized-index proofs off the device proof engine
+                # (?gindex=3&gindex=10,11; ?format=multiproof for the
+                # deduplicated helper set).  Malformed gindices are the
+                # client's fault: 400, never a 500.
+                qs = parse_qs(urlparse(h.path).query)
+                if "gindex" not in qs:
+                    h._json({"code": 400,
+                             "message": "missing gindex"}, 400)
+                    return
+                try:
+                    gindices = [int(x) for part in qs["gindex"]
+                                for x in part.split(",")]
+                except ValueError:
+                    h._json({"code": 400, "message": "bad gindex"}, 400)
+                    return
+                fmt = qs.get("format", ["single"])[0]
+                try:
+                    srv = chain.proof_server
+                    if fmt == "multiproof":
+                        leaves, helpers, hgs = srv.state_multiproof(
+                            state, gindices)
+                        body = {
+                            "leaves": ["0x" + b.hex() for b in leaves],
+                            "proof": ["0x" + b.hex() for b in helpers],
+                            "helper_gindices": [str(g) for g in hgs],
+                            "gindices": [str(g) for g in gindices]}
+                    else:
+                        branches = srv.state_proof(state, gindices)
+                        body = {"proofs": [
+                            {"gindex": str(g),
+                             "branch": ["0x" + b.hex()
+                                        for b in branches[g]]}
+                            for g in gindices]}
+                except ValueError as e:
+                    h._json({"code": 400, "message": str(e)}, 400)
+                    return
+                body["state_root"] = \
+                    "0x" + bytes(state.tree_hash_root()).hex()
+                h._json({"data": body})
             else:
                 h._json({"code": 404, "message": "unknown route"}, 404)
         elif path.startswith("/eth/v2/beacon/blocks/") \
@@ -527,6 +567,21 @@ class HttpApiServer:
                 "bytes_per_slot": WARM_SLOT_BUDGET,
                 "evaluation": evaluate_budget(deltas,
                                               include_cold=False),
+            }
+            # Proof-serving panel: coalescing efficiency + the per-slot
+            # D2H branch-pull bytes (the budget-relevant direction of
+            # the serving plane).  Raw attribute — a scrape must never
+            # construct the proof server.
+            srv = getattr(chain, "_proof_server", None)
+            snap["proof"] = {
+                "active": srv is not None,
+                "server": None if srv is None else srv.stats(),
+                "d2h_branch_bytes_per_slot": {
+                    row["slot"]:
+                        row["subsystems"]["proof_engine"]["d2h_bytes"]
+                    for row in deltas
+                    if row["subsystems"].get("proof_engine", {})
+                                        .get("d2h_bytes")},
             }
             h._json({"data": snap})
         elif path.startswith("/lighthouse/health"):

@@ -184,6 +184,21 @@ class BeaconChain:
             default_objectives(slot_seconds),
             min_eval_interval_s=slot_seconds / 2.0)
         wire_chain_feeds(self.slo_engine, self)
+        # Device proof serving (ops/proof_engine.ProofServer) is lazy:
+        # a chain that never serves a proof never builds a field tree.
+        # The proof_serve SLO feed and the /lighthouse/device panel read
+        # the raw attribute so a scrape can't instantiate it.
+        self._proof_server = None
+
+    @property
+    def proof_server(self):
+        """The chain's :class:`~lighthouse_tpu.ops.proof_engine.ProofServer`
+        (constructed on first use; serves state proofs and the re-homed
+        light-client branches)."""
+        if self._proof_server is None:
+            from ..ops.proof_engine import ProofServer
+            self._proof_server = ProofServer(self)
+        return self._proof_server
 
     # -- restart persistence -------------------------------------------------
 

@@ -87,6 +87,7 @@ SUBSYSTEMS: Tuple[str, ...] = (
     "slasher",          # min/max span planes
     "kzg",              # Deneb blob verification
     "staging",          # ChunkStager / cold-build streaming pushes
+    "proof_engine",     # device Merkle-branch extraction / proof serving
 )
 
 # Compile events that fire outside any attribution seam (conftest
@@ -139,6 +140,11 @@ WARM_SLOT_BUDGET: Dict[str, Dict[str, int]] = {
     # Cold-build streaming belongs OUTSIDE warm slots: a ChunkStager
     # push mid-slot means a full re-stage leaked onto the hot path.
     "staging": {"h2d_bytes": 0, "d2h_bytes": 0},
+    # Proof serving: branches are GATHERED from resident levels, never
+    # re-hashed — H2D is one small field-root plane per new head state,
+    # D2H is sibling rows (32 B each, bucket-padded).  A budget breach
+    # means serving went re-stage-shaped instead of gather-shaped.
+    "proof_engine": {"h2d_bytes": 2 * MiB, "d2h_bytes": 2 * MiB},
 }
 
 

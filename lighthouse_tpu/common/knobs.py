@@ -160,6 +160,18 @@ _declare("LIGHTHOUSE_TPU_BREAKER_N", "int", 5,
          "Consecutive device faults that trip the circuit breaker to "
          "host fallback.", min_value=1)
 
+# -- proof serving --
+_declare("LIGHTHOUSE_TPU_PROOF_DEVICE", "bool", True,
+         "Serve Merkle proofs by device gather from the resident field "
+         "tree (0 = host-walk oracle path).")
+_declare("LIGHTHOUSE_TPU_PROOF_WINDOW_MS", "float", 2.0,
+         "Proof-server micro-batching window: concurrent requests "
+         "arriving within it coalesce into one device gather.",
+         min_value=0.0)
+_declare("LIGHTHOUSE_TPU_PROOF_MAX_BATCH", "int", 1024,
+         "Distinct gindices that dispatch a proof batch early, before "
+         "the window closes.", min_value=1)
+
 # -- observability --
 _declare("LIGHTHOUSE_TPU_TRACE", "bool", False,
          "Enable slot-scope tracing at import.")
@@ -191,6 +203,9 @@ _declare("LIGHTHOUSE_TPU_SLO_SHED_PCT", "float", 0.1,
 _declare("LIGHTHOUSE_TPU_SLO_FALLBACK_PCT", "float", 1.0,
          "host_fallback_rate objective: max percent of dispatches "
          "served by the host oracle.", min_value=0.0)
+_declare("LIGHTHOUSE_TPU_SLO_PROOF_SERVE_MS", "float", 50.0,
+         "proof_serve objective: p99 wall budget per served proof "
+         "request.", min_value=1.0)
 _declare("LIGHTHOUSE_TPU_SLO_HYSTERESIS", "int", 2,
          "Consecutive evaluations a new health state must hold before "
          "the node transitions.", min_value=1)

@@ -125,6 +125,13 @@ def default_objectives(slot_seconds: float = 12.0) -> Tuple[Objective, ...]:
             severity=DEGRADED,
             description="dispatches served by the host oracle / total "
                         "dispatches"),
+        Objective(
+            "proof_serve_ms", feed="proof_serve", kind="latency",
+            budget=knob_float("LIGHTHOUSE_TPU_SLO_PROOF_SERVE_MS") / 1e3,
+            percentile=0.99, severity=DEGRADED,
+            description="p99 proof-request wall (light-client branches + "
+                        "state proofs off the device proof engine) — the "
+                        "serving plane must not stall behind imports"),
     )
 
 
@@ -614,8 +621,19 @@ def wire_chain_feeds(engine: SloEngine, chain) -> None:
             good += snap.get("device_ok", 0)
         return ("ratio", bad, bad + good)
 
+    def proof_serve():
+        # Raw attribute, NOT the lazy property — a feed evaluation must
+        # never construct the proof server; before the first proof
+        # request the objective simply has no window.
+        srv = getattr(chain, "_proof_server", None)
+        if srv is None:
+            return None
+        buckets, counts, total, _sum = srv.latency_snapshot()
+        return ("hist", buckets, counts, total)
+
     engine.register_feed("gossip_to_verified", gossip_to_verified)
     engine.register_feed("block_import", block_import)
     engine.register_feed("shed_rate", shed_rate)
     engine.register_feed("import_failure_rate", import_failure_rate)
     engine.register_feed("host_fallback_rate", host_fallback_rate)
+    engine.register_feed("proof_serve", proof_serve)

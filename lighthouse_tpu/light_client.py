@@ -25,6 +25,21 @@ from .ops.merkle import ZERO_HASHES_BYTES
 
 
 def _field_roots(state) -> List[bytes]:
+    """Per-field roots in FIELDS order, from the incremental tree-hash
+    cache's container-fold layer when the state carries one: a
+    ``tree_hash_root()`` call refreshes the layer diff-only, so repeated
+    proof requests against the same state stop re-hashing every field
+    (the old path rebuilt the whole layer — a SyncCommittee rehash alone
+    is ~1k hashes — per request)."""
+    thc = state.__dict__.get("_thc") if hasattr(state, "__dict__") else None
+    if thc is not None or hasattr(state, "tree_hash_root"):
+        try:
+            state.tree_hash_root()  # incremental; refreshes field_layer
+            layer = state.__dict__["_thc"].field_layer
+            if layer is not None:
+                return list(layer)
+        except (AttributeError, KeyError, TypeError):
+            pass
     return [ftype.hash_tree_root(getattr(state, fname))
             for fname, ftype in type(state).FIELDS.items()]
 
@@ -186,6 +201,23 @@ class LightClientServer:
     def __init__(self, chain):
         self.chain = chain
 
+    def _branch(self, state, field_name: str) -> List[bytes]:
+        """Field branch via the chain's device proof engine (one batched
+        gather over the resident field-root tree) with
+        :func:`state_field_proof`'s host walk as the differential oracle
+        — knob-off or any device failure falls back byte-identically."""
+        from .common.knobs import knob_bool
+        if self.chain is not None and \
+                knob_bool("LIGHTHOUSE_TPU_PROOF_DEVICE"):
+            try:
+                branch, _ = self.chain.proof_server.field_branch(
+                    state, field_name)
+                return branch
+            except Exception:
+                pass
+        branch, _ = state_field_proof(state, field_name)
+        return branch
+
     def _header(self, state, block_root: Optional[bytes] = None):
         hdr = state.latest_block_header.copy()
         if bytes(hdr.state_root) == b"\x00" * 32:
@@ -204,7 +236,7 @@ class LightClientServer:
     def bootstrap(self, block_root: Optional[bytes] = None
                   ) -> LightClientBootstrap:
         state = self.chain.head.state
-        branch, _ = state_field_proof(state, "current_sync_committee")
+        branch = self._branch(state, "current_sync_committee")
         return LightClientBootstrap(
             header=self._header(state),
             current_sync_committee=state.current_sync_committee,
@@ -220,7 +252,7 @@ class LightClientServer:
     def finality_update(self, sync_aggregate,
                         signature_slot: int) -> LightClientFinalityUpdate:
         state = self.chain.head.state
-        branch, _ = state_field_proof(state, "finalized_checkpoint")
+        branch = self._branch(state, "finalized_checkpoint")
         fin_root = bytes(state.finalized_checkpoint.root)
         fin_block = self.chain.store.get_block(fin_root)
         fin_header = (self._block_to_header(fin_block.message)
@@ -241,8 +273,8 @@ class LightClientServer:
         import time instead — pairing a cached aggregate with a later
         head header yields a signature no spec client accepts."""
         state = self.chain.head.state
-        next_branch, _ = state_field_proof(state, "next_sync_committee")
-        fin_branch, _ = state_field_proof(state, "finalized_checkpoint")
+        next_branch = self._branch(state, "next_sync_committee")
+        fin_branch = self._branch(state, "finalized_checkpoint")
         fin_root = bytes(state.finalized_checkpoint.root)
         fin_block = self.chain.store.get_block(fin_root)
         return LightClientUpdate(
@@ -285,8 +317,7 @@ class LightClientServer:
         slot = int(signed_block.message.slot)
         opt = LightClientOptimisticUpdate(
             attested_header=hdr, sync_aggregate=agg, signature_slot=slot)
-        fin_branch, _ = state_field_proof(parent_state,
-                                          "finalized_checkpoint")
+        fin_branch = self._branch(parent_state, "finalized_checkpoint")
         fin_root = bytes(parent_state.finalized_checkpoint.root)
         fin_block = self.chain.store.get_block(fin_root)
         fin_header = (self._block_to_header(fin_block.message)
@@ -300,8 +331,7 @@ class LightClientServer:
                 sync_aggregate=agg, signature_slot=slot,
                 finalized_checkpoint_epoch=int(
                     parent_state.finalized_checkpoint.epoch))
-        next_branch, _ = state_field_proof(parent_state,
-                                           "next_sync_committee")
+        next_branch = self._branch(parent_state, "next_sync_committee")
         period = LightClientUpdate(
             attested_header=hdr,
             next_sync_committee=parent_state.next_sync_committee,

@@ -45,9 +45,12 @@ driver, not for use inside a live node.
 
 from __future__ import annotations
 
+import threading
 import time
+import urllib.request
 from typing import List, Optional, Tuple
 
+from ..api.http_api import HttpApiServer
 from ..beacon_chain import BeaconChain
 from ..common.tracing import TRACER
 from ..network import GossipBus, NetworkNode
@@ -98,7 +101,8 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
                   slow_window_slots: int = 10,
                   hysteresis: int = 2,
                   warmup_slots: int = 1,
-                  max_batch: int = 32) -> dict:
+                  max_batch: int = 32,
+                  proof_consumers: int = 2) -> dict:
     """Run the drill; returns the scoreboard dict (raises nothing on a
     violated invariant — callers apply the exit-code contract).
 
@@ -107,7 +111,10 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
     aggregate (so the never-shed aggregate class carries fresh
     attesters, not pure duplicates).  ``faults_outage_slots`` is a
     half-open ``(start, stop)`` window of 0-based measured-slot indices
-    during which EVERY device dispatch of the streaming service fails."""
+    during which EVERY device dispatch of the streaming service fails.
+    ``proof_consumers`` threads hammer the light-client bootstrap and
+    state-proof HTTP routes for the whole measured run (the serving
+    plane under import load — the proof_serve_ms objective's signal)."""
     from ..crypto import bls
     from .harness import StateHarness
 
@@ -146,6 +153,10 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
         # prev_ring, which shrinks back lazily as new slots record.
         TRACER.enable(ring=ring_needed)
     node = None
+    api_srv = None
+    stop_consumers = threading.Event()
+    consumer_threads: List[threading.Thread] = []
+    proof_counts = {"requests": 0, "errors": 0}
     try:
         # Prep off-trace (trace_drill rule: the harness's own
         # transitions must not pollute the node's slot buckets).
@@ -267,6 +278,50 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
         # them; gossip flows too, warming the verify path.
         for w in range(1, warmup_slots + 1):
             drive_slot(w, None, 0.25, False, None)
+        if proof_consumers > 0:
+            # Warm the proof plane BEFORE measurement: the first request
+            # pays the gather-jit trace + first field-tree materialize —
+            # startup artifacts, same rule as the block-import warmup.
+            from ..light_client import LightClientServer
+            chain.proof_server.state_proof(chain.head.state, [3])
+            LightClientServer(chain).bootstrap()
+            api_srv = HttpApiServer(chain)
+            api_srv.start()
+            base = f"http://127.0.0.1:{api_srv.port}"
+            # A few always-valid field gindices of the state container
+            # (width + index), plus an interior node.
+            width = 1
+            while width < len(chain.head.state.__class__.FIELDS):
+                width *= 2
+            gindices = [3, width, width + 1, width + 5,
+                        f"{width + 2},{width + 9}"]
+
+            def consume(k: int) -> None:
+                i = k
+                while not stop_consumers.is_set():
+                    root = chain.head.root
+                    urls = [
+                        f"{base}/eth/v1/beacon/states/head/proof"
+                        f"?gindex={gindices[i % len(gindices)]}",
+                        f"{base}/eth/v1/beacon/light_client/bootstrap/"
+                        f"0x{bytes(root).hex()}",
+                    ]
+                    url = urls[i % len(urls)]
+                    i += 1
+                    try:
+                        with urllib.request.urlopen(url, timeout=10) as r:
+                            r.read()
+                        proof_counts["requests"] += 1
+                    except Exception:
+                        proof_counts["errors"] += 1
+                    stop_consumers.wait(slot_s / 8.0)
+
+            for k in range(proof_consumers):
+                t = threading.Thread(target=consume, args=(k,),
+                                     daemon=True,
+                                     name=f"proof-consumer-{k}")
+                t.start()
+                consumer_threads.append(t)
         engine.enabled = True
 
         # The measured run.
@@ -345,6 +400,16 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
                 time.sleep(min(slot_s / 2,
                                svc.envelope.breaker.cooldown_s))
 
+        # The fleet stops before the final verdict: its traffic belongs
+        # to the measured window, and a consumer mid-request during
+        # node.close() would read as a spurious error.
+        stop_consumers.set()
+        for t in consumer_threads:
+            t.join(timeout=5.0)
+        if api_srv is not None:
+            api_srv.stop()
+            api_srv = None
+
         final = engine.evaluate()
         st = svc.stats()
         # Warm-slot transfer budget (device ledger): close the open
@@ -376,6 +441,7 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
                 "seed": seed, "backend": backend,
                 "windows_slots": [fast_window_slots, slow_window_slots],
                 "hysteresis": hysteresis,
+                "proof_consumers": proof_consumers,
             },
             "wall_s": round(wall_s, 3),
             "rate_atts_per_s": round(
@@ -402,6 +468,13 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
             "attainment": attainments,
             "attainment_complete": all(
                 a is not None for a in attainments.values()),
+            "proof": {
+                "consumers": proof_consumers,
+                "consumer_requests": proof_counts["requests"],
+                "consumer_errors": proof_counts["errors"],
+                "server": (chain.proof_server.stats()
+                           if proof_consumers > 0 else None),
+            },
             "host_fallbacks": st["bls"]["host_fallbacks"],
             "breaker": st["bls"]["breaker"],
             "per_slot": per_slot,
@@ -433,6 +506,11 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
             }
         return scoreboard
     finally:
+        stop_consumers.set()
+        for t in consumer_threads:
+            t.join(timeout=5.0)
+        if api_srv is not None:
+            api_srv.stop()
         if node is not None:
             node.close()
         LEDGER.max_slots = prev_ledger_slots

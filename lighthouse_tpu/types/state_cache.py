@@ -279,6 +279,11 @@ class StateHashCache:
         self.device_packed: dict = {}  # fname → DevicePackedCache
         self.registry = RegistryCache()
         self.small: dict[str, tuple[bytes, bytes]] = {}  # fname → (enc, root)
+        # Per-field roots of the LAST root() fold — the proof plane
+        # (light_client / proof_engine) reads this instead of re-hashing
+        # every field per request.  Valid only for the root just
+        # computed; root() refreshes it, copy() drops it.
+        self.field_layer: list | None = None
 
     @staticmethod
     def _packed_limits(ftype) -> tuple[int, bool]:
@@ -340,6 +345,7 @@ class StateHashCache:
                     self.small[fname] = (enc, r)
                     leaves.append(r)
         HASH_COUNT[0] += len(leaves)  # container fold, ~2 per leaf
+        self.field_layer = leaves
         return merkleize_host(leaves)
 
     def copy(self) -> "StateHashCache":
@@ -350,4 +356,5 @@ class StateHashCache:
                              for k, c in self.device_packed.items()}
         out.registry = self.registry.copy()
         out.small = dict(self.small)
+        out.field_layer = None
         return out

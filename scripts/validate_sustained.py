@@ -117,6 +117,18 @@ def main(argv=None) -> int:
         dead = [k for k, v in board["attainment"].items() if v is None]
         failures.append(f"objectives with no attainment (dead feed?): "
                         f"{dead}")
+    proof = board.get("proof", {})
+    if proof.get("consumers", 0) > 0:
+        # The proof-consumer fleet must actually have exercised the
+        # serving plane (a silent fleet would leave the proof_serve
+        # objective windowless) and every request must have been served.
+        if proof.get("consumer_requests", 0) == 0:
+            failures.append("proof-consumer fleet made no requests")
+        if proof.get("consumer_errors", 0):
+            failures.append(
+                f"proof-consumer errors: {proof['consumer_errors']} of "
+                f"{proof['consumer_requests'] + proof['consumer_errors']}"
+                f" requests failed")
     if not board["device_budget"]["ok"]:
         # Warm-slot transfer budget (device ledger): a subsystem moved
         # more bytes in a measured slot than residency allows — the hot
@@ -164,6 +176,7 @@ def main(argv=None) -> int:
         "transitions": [(t["from"], t["to"], t["reasons"])
                         for t in transitions],
         "host_fallbacks": board["host_fallbacks"],
+        "proof": board.get("proof"),
         "device_budget_ok": board["device_budget"]["ok"],
         "device_budget_attainment": board["device_budget"]["attainment"],
         "artifact": args.out,

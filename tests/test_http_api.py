@@ -395,3 +395,73 @@ def test_lc_updates_route_serves_import_time_update(api):
     assert verify_field_proof(
         h.T.SyncCommittee.hash_tree_root(committee), branch, idx,
         bytes(parent.message.state_root))
+
+
+def _get_err(srv, path):
+    try:
+        _get(srv, path)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+    raise AssertionError(f"{path} unexpectedly succeeded")
+
+
+def test_state_proof_route(api):
+    """/eth/v1/beacon/states/{id}/proof — device-extracted branches
+    verify against the served state root."""
+    import hashlib
+
+    h, chain, srv = api
+    state = chain.head.state
+    names = list(type(state).FIELDS)
+    width = 1
+    while width < len(names):
+        width *= 2
+    idx = names.index("slot")
+    g = width + idx
+    body = _get(srv, f"/eth/v1/beacon/states/head/proof?gindex={g}")
+    data = body["data"]
+    assert data["proofs"][0]["gindex"] == str(g)
+    branch = [bytes.fromhex(x[2:]) for x in data["proofs"][0]["branch"]]
+    ftype = type(state).FIELDS["slot"]
+    node = ftype.hash_tree_root(state.slot)
+    i = idx
+    for sib in branch:
+        node = (hashlib.sha256(sib + node).digest() if i & 1
+                else hashlib.sha256(node + sib).digest())
+        i //= 2
+    assert "0x" + node.hex() == data["state_root"]
+    assert data["state_root"] == \
+        "0x" + bytes(state.tree_hash_root()).hex()
+
+
+def test_state_proof_route_multiproof(api):
+    from lighthouse_tpu.ops.proof_engine import verify_merkle_multiproof
+
+    h, chain, srv = api
+    state = chain.head.state
+    width = 1
+    while width < len(type(state).FIELDS):
+        width *= 2
+    gs = [width, width + 3, width + 5]
+    body = _get(srv, "/eth/v1/beacon/states/head/proof?format=multiproof"
+                     "&gindex=" + ",".join(str(g) for g in gs))
+    data = body["data"]
+    leaves = [bytes.fromhex(x[2:]) for x in data["leaves"]]
+    proof = [bytes.fromhex(x[2:]) for x in data["proof"]]
+    root = bytes.fromhex(data["state_root"][2:])
+    assert verify_merkle_multiproof(leaves, proof, gs, root)
+
+
+def test_state_proof_route_malformed_gindex_400(api):
+    h, chain, srv = api
+    code, body = _get_err(srv, "/eth/v1/beacon/states/head/proof")
+    assert code == 400 and "gindex" in body["message"]
+    code, body = _get_err(
+        srv, "/eth/v1/beacon/states/head/proof?gindex=pony")
+    assert code == 400
+    code, body = _get_err(
+        srv, "/eth/v1/beacon/states/head/proof?gindex=0")
+    assert code == 400
+    code, body = _get_err(
+        srv, "/eth/v1/beacon/states/head/proof?gindex=999999")
+    assert code == 400

@@ -1,0 +1,252 @@
+"""Device proof engine — the differential battery (ISSUE 17).
+
+Every byte the device gather serves must equal the host oracles it
+replaced: `ops/merkle_proof.MerkleTree.proof` for raw trees and
+`light_client.state_field_proof` for state-field branches.  The engine
+never hashes — so any mismatch is a coordinate/layout bug, never a
+rounding story.
+"""
+
+import hashlib
+import threading
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.light_client import (LightClientServer, _field_roots,
+                                         state_field_proof,
+                                         verify_field_proof)
+from lighthouse_tpu.ops.device_tree import DeviceTree
+from lighthouse_tpu.ops.merkle import ZERO_HASHES_BYTES, _next_pow2
+from lighthouse_tpu.ops.merkle_proof import MerkleTree, verify_merkle_proof
+from lighthouse_tpu.ops.proof_engine import (DeviceProofEngine, ProofServer,
+                                             branch_gindices,
+                                             helper_gindices, path_gindices,
+                                             verify_merkle_multiproof)
+from lighthouse_tpu.ops.sha256 import words_to_bytes
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+def _leaves(n: int, seed: int = 0) -> list:
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def _plane(leaves: list) -> np.ndarray:
+    w = _next_pow2(max(len(leaves), 1))
+    rows = list(leaves) + [ZERO_HASHES_BYTES[0]] * (w - len(leaves))
+    return (np.frombuffer(b"".join(rows), dtype=">u4")
+            .astype(np.uint32).reshape(w, 8))
+
+
+def _engine(leaves: list) -> DeviceProofEngine:
+    return DeviceProofEngine(DeviceTree.from_host_leaves(_plane(leaves)))
+
+
+# ---------------------------------------------------------------------------
+# gindex arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_gindex_helpers():
+    assert branch_gindices(1) == []
+    assert branch_gindices(9) == [8, 5, 3]
+    assert path_gindices(9) == [9, 4, 2]
+    # Two sibling leaves prove each other: no helpers at their level.
+    assert helper_gindices([8, 9]) == [5, 3]
+    assert helper_gindices([9]) == [8, 5, 3]
+
+
+# ---------------------------------------------------------------------------
+# differential battery vs MerkleTree (incl. non-power-of-two widths)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 5, 8, 13, 32, 100])
+def test_device_branches_match_merkle_tree(n):
+    leaves = _leaves(n, seed=n)
+    w = _next_pow2(max(n, 1))
+    depth = w.bit_length() - 1
+    host = MerkleTree(depth)
+    for lf in leaves:
+        host.push_leaf(lf)
+    eng = _engine(leaves)
+    root = words_to_bytes(eng.tree.root_words())
+    assert root == host.root()
+    gs = [w + i for i in range(n)]
+    branches = eng.branches(gs)
+    for i in range(n):
+        expect = host.proof(i)
+        got = branches[w + i]
+        assert got == expect, f"leaf {i} branch diverges"
+        assert verify_merkle_proof(leaves[i], got, depth, i, root)
+
+
+def test_interior_nodes_match_host_levels():
+    leaves = _leaves(13, seed=99)
+    eng = _engine(leaves)
+    # Host levels by direct hashlib fold over the padded width.
+    lv = leaves + [ZERO_HASHES_BYTES[0]] * (16 - 13)
+    levels = [list(lv)]
+    while len(lv) > 1:
+        lv = [hashlib.sha256(lv[i] + lv[i + 1]).digest()
+              for i in range(0, len(lv), 2)]
+        levels.append(lv)
+    depth = len(levels) - 1
+    # Every node of the tree, all depths at once (one batched extract).
+    all_gs = [g for g in range(1, 32)]
+    nodes = eng.extract_nodes(all_gs)
+    for g in all_gs:
+        d = g.bit_length() - 1
+        assert nodes[g] == levels[depth - d][g - (1 << d)], \
+            f"gindex {g} (depth {d}) diverges"
+
+
+@pytest.mark.parametrize("gset", [[8], [8, 9], [8, 5], [4, 6],
+                                  [9, 13, 14], [8, 9, 10, 11]])
+def test_multiproof_verifies(gset):
+    leaves = _leaves(8, seed=3)
+    eng = _engine(leaves)
+    root = words_to_bytes(eng.tree.root_words())
+    lvs, proof, helpers = eng.multiproof(gset)
+    assert helpers == helper_gindices(gset)
+    assert verify_merkle_multiproof(lvs, proof, gset, root)
+    if proof:  # perturbation must break it
+        bad = [b"\x00" * 32] + proof[1:]
+        assert not verify_merkle_multiproof(lvs, bad, gset, root)
+    assert not verify_merkle_multiproof(lvs, proof, gset, b"\x11" * 32)
+
+
+def test_bad_gindex_raises():
+    eng = _engine(_leaves(8))
+    with pytest.raises(ValueError):
+        eng.extract_nodes([0])
+    with pytest.raises(ValueError):
+        eng.branches([1 << 10])
+
+
+# ---------------------------------------------------------------------------
+# ProofServer over a real BeaconState
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def chain():
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.store import HotColdDB
+
+    B.set_backend("fake")
+    h = StateHarness(n_validators=16, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    c = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                    genesis_state=h.state.copy(),
+                    genesis_block_root=hdr.tree_hash_root(),
+                    preset=h.preset, spec=h.spec, T=h.T)
+    yield h, c
+    B.set_backend("python")
+
+
+def test_field_branch_matches_host_oracle_every_field(chain):
+    h, c = chain
+    state = c.head.state
+    srv = c.proof_server
+    root = bytes(state.tree_hash_root())
+    for fname, ftype in type(state).FIELDS.items():
+        dev_branch, dev_idx = srv.field_branch(state, fname)
+        host_branch, host_idx = state_field_proof(state, fname)
+        assert dev_idx == host_idx
+        assert dev_branch == host_branch, f"{fname} branch diverges"
+        assert verify_field_proof(
+            ftype.hash_tree_root(getattr(state, fname)),
+            dev_branch, dev_idx, root)
+
+
+def test_knob_off_host_path_byte_equal(chain, monkeypatch):
+    h, c = chain
+    state = c.head.state
+    width = _next_pow2(len(type(state).FIELDS))
+    gs = [width + 1, width + 4, 3]
+    dev = ProofServer(c).state_proof(state, gs)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_PROOF_DEVICE", "0")
+    host_srv = ProofServer(c)
+    host = host_srv.state_proof(state, gs)
+    assert dev == host
+    assert host_srv.host_served == 1 and host_srv.device_served == 0
+
+
+def test_lc_server_branches_device_and_oracle_agree(chain, monkeypatch):
+    h, c = chain
+    lcs = LightClientServer(c)
+    boot_dev = lcs.bootstrap()
+    monkeypatch.setenv("LIGHTHOUSE_TPU_PROOF_DEVICE", "0")
+    boot_host = lcs.bootstrap()
+    assert boot_dev.current_sync_committee_branch == \
+        boot_host.current_sync_committee_branch
+    state = c.head.state
+    assert boot_dev.verify(c.head.root, state, c.T)
+
+
+def test_state_proof_validates_gindices(chain):
+    h, c = chain
+    state = c.head.state
+    srv = c.proof_server
+    with pytest.raises(ValueError):
+        srv.state_proof(state, [0])
+    with pytest.raises(ValueError):
+        srv.state_proof(state, [10**9])
+
+
+def test_concurrent_requests_coalesce(chain):
+    h, c = chain
+    state = c.head.state
+    srv = ProofServer(c, window_ms=60.0, max_batch=1024)
+    width = _next_pow2(len(type(state).FIELDS))
+    srv.state_proof(state, [width])  # warm: engine build + jit
+    base_dispatches = srv.dispatches
+    results = []
+    errors = []
+    start = threading.Barrier(8)
+
+    def worker(k):
+        try:
+            start.wait(timeout=10)
+            results.append(srv.state_proof(state, [width + k % 4]))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert len(results) == 8
+    # 8 concurrent requests over 4 distinct gindices ride few windows —
+    # strictly fewer dispatches than requests, with coalesced hits.
+    assert srv.dispatches - base_dispatches < 8
+    oracle = {k: state_field_proof(
+        state, list(type(state).FIELDS)[k])[0] for k in range(4)}
+    for r in results:
+        (g, branch), = r.items()
+        assert branch == oracle[g - width]
+
+
+def test_field_layer_cache_populated(chain):
+    h, c = chain
+    state = c.head.state
+    state.tree_hash_root()
+    thc = state.__dict__["_thc"]
+    assert thc.field_layer is not None
+    assert len(thc.field_layer) == len(type(state).FIELDS)
+    # _field_roots serves from the cached layer, byte-equal to the
+    # per-field rebuild it replaced.
+    rebuilt = [ftype.hash_tree_root(getattr(state, fname))
+               for fname, ftype in type(state).FIELDS.items()]
+    assert _field_roots(state) == rebuilt
+    # The copy drops the layer (the twin mutates independently).
+    assert state.copy().__dict__["_thc"].field_layer is None
