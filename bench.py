@@ -941,13 +941,124 @@ def _fork_choice_bench() -> dict:
     return out
 
 
-def _op_pool_bench() -> dict:
-    """BASELINE row 5: max-cover packing over 100k pooled attestations."""
-    from lighthouse_tpu.op_pool import bench_pack_attestations
+def _with_pack_knob(value, fn):
+    """Run ``fn`` with LIGHTHOUSE_TPU_DEVICE_PACK pinned (knobs read the
+    environment at call time; bench rows own the process env, so plain
+    set/pop like validate_transition.py)."""
+    os.environ["LIGHTHOUSE_TPU_DEVICE_PACK"] = value
+    try:
+        return fn()
+    finally:
+        os.environ.pop("LIGHTHOUSE_TPU_DEVICE_PACK", None)
 
-    ms, packed = bench_pack_attestations(100_000)
-    return {"op_pool_pack_100k_ms": round(ms, 1),
-            "op_pool_packed": packed}
+
+def _op_pool_bench() -> dict:
+    """BASELINE row 5: max-cover packing over 100k (and 500k) pooled
+    attestations — the host CELF oracle against the fixed-shape device
+    greedy-pack, plus the HBM-roofline model of the pack rounds (the
+    number a real TPU's pack dispatch is bounded by; on host-only boxes
+    the device engine is the numpy rounds oracle, so the model carries
+    the device claim the same way ``block_with_sigs`` models the
+    signature mesh)."""
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu.op_pool import bench_pack_attestations
+    from lighthouse_tpu.op_pool.device_pack import modeled_pack_ms
+
+    out = {}
+    host_ms, host_packed = _with_pack_knob(
+        "0", lambda: bench_pack_attestations(100_000))
+    dev_ms, dev_packed = _with_pack_knob(
+        "1", lambda: bench_pack_attestations(100_000))
+    stats = tracing.stage_split("op_pool")
+    modeled = modeled_pack_ms(stats.get("entries", 0),
+                              stats.get("candidates", 0),
+                              stats.get("rounds", 0))
+    out["op_pool_pack_100k_ms"] = round(host_ms, 1)
+    out["op_pool_pack_100k_device_path_ms"] = round(dev_ms, 1)
+    out["op_pool_pack_100k_modeled_device_ms"] = round(modeled, 2)
+    out["op_pool_pack_100k_modeled_speedup"] = round(
+        host_ms / modeled, 1) if modeled > 0 else None
+    out["op_pool_pack_100k_match"] = host_packed == dev_packed
+    out["op_pool_packed"] = dev_packed
+    out["op_pool_pack_engine"] = stats.get("engine")
+    out["op_pool_pack_stage_split"] = {
+        k: round(v, 2) if isinstance(v, float) else v
+        for k, v in stats.items()}
+    # 500k: host oracle measured live; the device side is the roofline
+    # model on the linearly-scaled shape (the fixture is uniform per
+    # aggregate) — re-running the numpy rounds oracle at 5x the shape
+    # costs ~2 min of bench wall for no extra signal, and selection
+    # parity is the differential suite's job, not this row's.
+    host_ms5, _packed5 = _with_pack_knob(
+        "0", lambda: bench_pack_attestations(500_000))
+    modeled5 = modeled_pack_ms(stats.get("entries", 0) * 5,
+                               stats.get("candidates", 0) * 5,
+                               stats.get("rounds", 0))
+    out["op_pool_pack_500k_ms"] = round(host_ms5, 1)
+    out["op_pool_pack_500k_modeled_device_ms"] = round(modeled5, 2)
+    out["op_pool_pack_500k_modeled_speedup"] = round(
+        host_ms5 / modeled5, 1) if modeled5 > 0 else None
+    return out
+
+
+def _block_production_bench() -> dict:
+    """End-to-end block production on a live MINIMAL chain: adopt the
+    speculatively pre-advanced state → pack the pool → assemble + state
+    root, with the adopt/pack/assemble phase split from the op_pool
+    stage source.  The ``block_production_ms`` key is the SLO
+    objective's bench-side twin (budget: slot/3)."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu.store import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+    from lighthouse_tpu.validator_client.beacon_node import (
+        InProcessBeaconNode,
+    )
+
+    h = StateHarness(n_validators=64, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(
+        store=HotColdDB.memory(h.preset, h.spec, h.T),
+        genesis_state=h.state.copy(),
+        genesis_block_root=hdr.tree_hash_root(),
+        preset=h.preset, spec=h.spec, T=h.T)
+    bn = InProcessBeaconNode(chain)
+    # A few slots of real traffic so the pool has something to pack.
+    for slot in range(1, 4):
+        chain.per_slot_task(slot)
+        signed = h.build_block(slot=slot, attestations=[])
+        h.apply_block(signed)
+        chain.process_block(signed, is_timely=True)
+        from lighthouse_tpu.state_transition.per_slot import process_slots
+        adv = process_slots(h.state.copy(), slot + 1, h.preset, h.spec,
+                            h.T)
+        chain.process_attestation_batch(h.attestations_for_slot(adv, slot))
+    slot = 4
+    chain.per_slot_task(slot)  # primes the speculative pre-advance
+    from lighthouse_tpu.op_pool.device_pack import reset_stats
+    reset_stats()  # a previous row's pack must not leak into the split
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        bn.produce_block(slot, b"\x00" * 96)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    total = min(ts)
+    split = tracing.stage_split("op_pool")
+    adopt = split.get("adopt_ms", 0.0) or 0.0
+    pack = sum(split.get(k, 0.0) or 0.0
+               for k in ("csr_build_ms", "coverage_ms",
+                         "select_rounds_ms"))
+    return {
+        "block_production_ms": round(total, 2),
+        "block_production_adopted": bool(split.get("adopted")),
+        "block_production_phases": {
+            "adopt_ms": round(adopt, 3),
+            "pack_ms": round(pack, 3),
+            "assemble_ms": round(max(total - adopt - pack, 0.0), 3),
+        },
+    }
 
 
 def _breaker_attribution(prefix: str, before=None):
@@ -1448,6 +1559,7 @@ _ROWS = [
      "state_root_device_resident", True),
     ("fork_choice", _fork_choice_bench, "fork_choice_apply", False),
     ("op_pool", _op_pool_bench, "op_pool_pack_100k", False),
+    ("production", _block_production_bench, "block_production", False),
     ("slasher", _slasher_bench, "slasher_span_update_1m", False),
     ("block", _block_transition_bench, "block_transition_128att", False),
     ("block_sigs", _block_with_sigs_bench, "block_with_sigs", False),

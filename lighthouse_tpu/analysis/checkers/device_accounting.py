@@ -56,6 +56,7 @@ DEVICE_MODULES = frozenset({
     "lighthouse_tpu/types/device_state.py",
     "lighthouse_tpu/types/validators.py",
     "lighthouse_tpu/fork_choice/device_proto_array.py",
+    "lighthouse_tpu/op_pool/device_pack.py",
     "lighthouse_tpu/slasher/device_spans.py",
     "lighthouse_tpu/parallel/pipeline.py",
     "lighthouse_tpu/kzg/device.py",

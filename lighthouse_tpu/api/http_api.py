@@ -611,9 +611,12 @@ class HttpApiServer:
         epoch instead would deadlock a quiet chain — a VC asking for the
         current epoch would get 400, never learn it proposes, and the head
         would never advance.
+
+        Served from the chain's pre-materialized :class:`DutyCache`
+        (primed by the idle-tail lookahead, so the steady-state request
+        is a list read; a cold miss builds the cache ONCE through the
+        chain's advanced-state memo instead of shuffling per request).
         """
-        from ..state_transition.committees import get_beacon_proposer_index
-        from ..state_transition.per_slot import process_slots
         chain = self.chain
         spe = chain.preset.SLOTS_PER_EPOCH
         now_epoch = max(chain.current_slot(), chain.head.slot) // spe
@@ -621,29 +624,14 @@ class HttpApiServer:
             raise ValueError(
                 f"proposer duties only for epochs {now_epoch}.."
                 f"{now_epoch + 1}")
-        state = chain.head.state
-        first = epoch * spe
-        if int(state.slot) < first:
-            # Memoise through the chain's advanced-state cache — a VC
-            # polling next-epoch duties every slot would otherwise pay a
-            # full epoch advance (~100 MB state copy + epoch processing at
-            # registry scale) per request on the API thread.
-            key = (chain.head.root, first)
-            advanced = chain._advanced_states.get(key)
-            if advanced is None:
-                advanced = process_slots(state.copy(), first, chain.preset,
-                                         chain.spec, chain.T)
-                chain._bound_advanced_states()
-                chain._advanced_states[key] = advanced
-            state = advanced
-        reg = state.validators
+        cache = chain.duty_cache(epoch)
+        reg = chain.head.state.validators
         out = []
-        for slot in range(first, first + spe):
-            idx = get_beacon_proposer_index(state, chain.preset, slot=slot)
+        for k, idx in enumerate(cache.proposers):
             out.append({
                 "pubkey": "0x" + reg.pubkey[idx].tobytes().hex(),
                 "validator_index": str(idx),
-                "slot": str(slot)})
+                "slot": str(cache.first_slot + k)})
         return out
 
     def _serve_events(self, h) -> None:

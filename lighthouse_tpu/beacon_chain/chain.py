@@ -53,6 +53,64 @@ class CanonicalHead:
     state: object
 
 
+class DutyCache:
+    """Pre-materialized proposer/committee duties for ONE
+    (head root, epoch) — the shuffle/lookahead cache behind the duties
+    endpoints and block assembly (`beacon_proposer_cache.rs` +
+    `validator/duties` recompute avoidance).
+
+    ``proposers[slot - first_slot]`` is the proposer index;
+    attester duties resolve through a vectorized inverse-shuffle map
+    (validator → position in the epoch's shuffled column) built ONCE
+    per epoch per head, so a duties request for millions of keys is a
+    numpy gather, not a per-request committee walk."""
+
+    def __init__(self, head_root: bytes, epoch: int, first_slot: int,
+                 proposers: List[int], committees) -> None:
+        self.head_root = head_root
+        self.epoch = epoch
+        self.first_slot = first_slot
+        self.proposers = proposers
+        self.committees = committees          # CommitteeCache
+        self._inv = None                      # validator → shuffled pos
+
+    def proposer_at(self, slot: int) -> int:
+        return self.proposers[int(slot) - self.first_slot]
+
+    def _inverse(self, n_validators: int) -> np.ndarray:
+        if self._inv is None or self._inv.shape[0] < n_validators:
+            inv = np.full(n_validators, -1, np.int64)
+            shuffled = self.committees.shuffled
+            inv[shuffled] = np.arange(shuffled.shape[0], dtype=np.int64)
+            self._inv = inv
+        return self._inv
+
+    def attester_duty(self, validator_index: int, n_validators: int):
+        """``(slot, committee_index, position, committee_length)`` for
+        one validator, or ``None`` (inactive this epoch)."""
+        vi = int(validator_index)
+        if vi >= n_validators:
+            return None
+        j = int(self._inverse(n_validators)[vi])
+        if j < 0:
+            return None
+        cc = self.committees
+        n = cc.shuffled.shape[0]
+        count = cc.committees_per_slot * cc.slots_per_epoch
+        # committee i owns shuffled[n*i//count : n*(i+1)//count]; invert
+        # the slice arithmetic: i is the last committee starting at or
+        # before j.
+        i = (j * count) // n
+        while n * i // count > j:
+            i -= 1
+        while n * (i + 1) // count <= j:
+            i += 1
+        start = n * i // count
+        end = n * (i + 1) // count
+        slot = self.first_slot + i // cc.committees_per_slot
+        return (slot, i % cc.committees_per_slot, j - start, end - start)
+
+
 class SyncMessagePool:
     """Naive per-slot aggregation of sync-committee messages
     (`naive_aggregation_pool.rs`, sync flavour): votes keyed by
@@ -127,6 +185,7 @@ class BeaconChain:
         self._states_by_block: dict[bytes, object] = {
             genesis_block_root: genesis_state.copy()}
         self._advanced_states: dict = {}
+        self._duty_caches: dict = {}
         from .attester_cache import (
             AttesterCache, BlockTimesCache, EarlyAttesterCache)
         self.attester_cache = AttesterCache()
@@ -171,6 +230,20 @@ class BeaconChain:
         # increments; the feed reads them racily by design).
         self._slo_import_attempts = 0
         self._slo_import_failures = 0
+        # block_production_ms feed: one observation per assembled block
+        # (the proposer's adopt → pack → assemble wall).  Bucket bounds
+        # bracket the slot/3 budgets this repo actually runs (0.333 s
+        # compressed drill, 2 s MINIMAL, 4 s mainnet).
+        self._slo_production_hist = Histogram(
+            "block_production_seconds_local", "",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.167, 0.25, 0.333,
+                     0.5, 1.0, 2.0, 4.0))
+        # Speculative pre-advance adoption counters (GIL-atomic ints):
+        # adopted = production found the pre-advanced state for the
+        # unchanged head; serial = it advanced at production time (cold
+        # start, reorg discard, or the knob off).
+        self._produce_adopted = 0
+        self._produce_serial = 0
         slot_seconds = getattr(self.spec, "seconds_per_slot", 12)
         # Evaluation cadence ≈ slot cadence: hysteresis counts
         # EVALUATIONS, and the HTTP routes also tick — without this a
@@ -320,6 +393,7 @@ class BeaconChain:
         chain.fork_choice = fc
         chain._states_by_block = {}
         chain._advanced_states = {}
+        chain._duty_caches = {}
         from .attester_cache import (
             AttesterCache, BlockTimesCache, EarlyAttesterCache)
         chain.attester_cache = AttesterCache()
@@ -444,6 +518,12 @@ class BeaconChain:
         self._advanced_states[key] = advanced
         self.attester_cache.prime_from_state(head.root, advanced,
                                              self.preset)
+        # Duty lookahead rides the same idle-tail advance: proposer +
+        # committee duties for the advanced epoch materialize here, so
+        # production and the duties endpoints find them without a
+        # per-request shuffle (tentpole (c)).
+        self._prime_duties(head.root, advanced,
+                           target_slot // self.preset.SLOTS_PER_EPOCH)
 
     def on_three_quarters_slot(self, slot: int) -> None:
         """`state_advance_timer.rs:94-106`: at 3/4 of slot N, pre-advance
@@ -497,6 +577,71 @@ class BeaconChain:
                                                      self.preset)
             entry = self.attester_cache.get(head_root, epoch)
         return entry
+
+    # -- duty caches ---------------------------------------------------------
+
+    DUTY_CACHE_SIZE = 4
+
+    def _prime_duties(self, head_root: bytes, state, epoch: int) -> None:
+        """Materialize the (head, epoch) :class:`DutyCache` from an
+        already-hot state (best-effort: duty priming must never kill a
+        timer tick)."""
+        key = (head_root, int(epoch))
+        if key in self._duty_caches:
+            return
+        from ..state_transition.committees import get_committee_cache
+        spe = self.preset.SLOTS_PER_EPOCH
+        first = int(epoch) * spe
+        try:
+            cc = get_committee_cache(state, int(epoch), self.preset)
+            proposers = [
+                get_beacon_proposer_index(state, self.preset, slot=s)
+                for s in range(first, first + spe)]
+        except Exception:
+            return
+        while len(self._duty_caches) >= self.DUTY_CACHE_SIZE:
+            self._duty_caches.pop(next(iter(self._duty_caches)))
+        self._duty_caches[key] = DutyCache(head_root, int(epoch), first,
+                                           proposers, cc)
+
+    def duty_cache(self, epoch: int) -> DutyCache:
+        """The (current head, ``epoch``) duty cache, built on demand —
+        the serving path of ``/eth/v1/validator/duties/*`` and the
+        production pipeline's proposer feed.  For a FUTURE epoch the
+        build memoises through ``_advanced_states`` (the speculative
+        pre-advance usually got there first, making this a lookup)."""
+        head = self.head
+        key = (head.root, int(epoch))
+        hit = self._duty_caches.get(key)
+        if hit is not None:
+            return hit
+        spe = self.preset.SLOTS_PER_EPOCH
+        first = int(epoch) * spe
+        state = head.state
+        head_epoch = int(state.slot) // spe
+        if int(epoch) > head_epoch + 1:
+            # Same amplification gate as the HTTP duties routes: a
+            # far-future epoch would drive process_slots for billions
+            # of slots to build its shuffle.
+            raise ValueError(
+                f"duties unavailable for epoch {epoch}: head epoch "
+                f"{head_epoch} (served: ≤ {head_epoch + 1})")
+        if int(state.slot) < first:
+            akey = (head.root, first)
+            advanced = self._advanced_states.get(akey)
+            if advanced is None:
+                advanced = process_slots(state.copy(), first, self.preset,
+                                         self.spec, self.T)
+                self._bound_advanced_states()
+                self._advanced_states[akey] = advanced
+            state = advanced
+        self._prime_duties(head.root, state, int(epoch))
+        cache = self._duty_caches.get(key)
+        if cache is None:  # prime failed (epoch outside cache range)
+            raise ValueError(
+                f"duties unavailable for epoch {epoch} at head slot "
+                f"{int(state.slot)}")
+        return cache
 
     # -- state lookup --------------------------------------------------------
 
@@ -941,22 +1086,73 @@ class BeaconChain:
 
     # -- production ----------------------------------------------------------
 
+    def produce_block_components(self, slot: int, randao_reveal: bytes,
+                                 graffiti: bytes = b"") -> object:
+        """Produce at device rate: adopt the speculatively pre-advanced
+        state when the head it was built on is still the head, else fall
+        back to a serial advance (`state_advance_timer.rs:94-106` — the
+        pre-advance is only usable if no block landed in between).  The
+        head is read ONCE so the adoption check and the parent root
+        cannot race a concurrent head swap."""
+        from ..common.knobs import knob_bool
+        from ..op_pool import device_pack
+        t0 = time.perf_counter()
+        head = self.head
+        state = None
+        adopted = False
+        if knob_bool("LIGHTHOUSE_TPU_SPECULATIVE_PRODUCE") \
+                and int(head.state.slot) < slot:
+            adv = self._advanced_states.get((head.root, slot))
+            if adv is not None and int(adv.slot) == slot:
+                # copy() COW-shares the device-resident columns: the
+                # adopt cost is O(metadata), not O(validators).
+                state = adv.copy()
+                adopted = True
+        if state is None:
+            state = head.state.copy()
+        if adopted:
+            self._produce_adopted += 1
+        else:
+            self._produce_serial += 1
+        device_pack.note_adopt((time.perf_counter() - t0) * 1e3, adopted)
+        return self.produce_block_on_state(state, slot, randao_reveal,
+                                           graffiti, _head_root=head.root)
+
+    def note_block_production(self, seconds: float) -> None:
+        """Feed one end-to-end block-production latency into the local
+        SLO histogram (drives the ``block_production_ms`` objective)."""
+        self._slo_production_hist.observe(seconds)
+        observe("block_production_seconds", seconds)
+
+    def _proposer_for(self, slot: int, state, head_root: bytes = None) -> int:
+        """Proposer index for ``slot`` — pre-materialized duty cache
+        when the lookahead primed it (tentpole (c)), shuffle-on-demand
+        otherwise."""
+        if head_root is not None:
+            cache = self._duty_caches.get(
+                (head_root, slot // self.preset.SLOTS_PER_EPOCH))
+            if cache is not None:
+                return cache.proposer_at(slot)
+        return get_beacon_proposer_index(state, self.preset, slot=slot)
+
     def produce_block_on_state(self, state, slot: int, randao_reveal: bytes,
-                               graffiti: bytes = b"") -> object:
+                               graffiti: bytes = b"",
+                               _head_root: bytes = None) -> object:
         """Assemble an unsigned block from the op pool
         (`produce_block_on_state`, `beacon_chain.rs:4133`)."""
         if int(state.slot) < slot:
             state = process_slots(state.copy(), slot, self.preset, self.spec,
                                   self.T)
         fork = self.spec.fork_name_at_epoch(slot // self.preset.SLOTS_PER_EPOCH)
-        proposer = get_beacon_proposer_index(state, self.preset, slot=slot)
+        proposer = self._proposer_for(slot, state, _head_root)
         atts = self.op_pool.get_attestations(state, self.T)
         proposer_slashings, attester_slashings, exits = \
             self.op_pool.get_slashings_and_exits(state)
         changes = self.op_pool.get_bls_to_execution_changes(state)
         return dict(
             slot=slot, proposer_index=proposer,
-            parent_root=self.head.root,
+            parent_root=_head_root if _head_root is not None
+            else self.head.root,
             attestations=atts,
             proposer_slashings=proposer_slashings,
             attester_slashings=attester_slashings,

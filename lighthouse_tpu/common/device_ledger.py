@@ -88,6 +88,7 @@ SUBSYSTEMS: Tuple[str, ...] = (
     "kzg",              # Deneb blob verification
     "staging",          # ChunkStager / cold-build streaming pushes
     "proof_engine",     # device Merkle-branch extraction / proof serving
+    "op_pool",          # block-packing CSR columns + greedy-pack rounds
 )
 
 # Compile events that fire outside any attribution seam (conftest
@@ -145,6 +146,11 @@ WARM_SLOT_BUDGET: Dict[str, Dict[str, int]] = {
     # D2H is sibling rows (32 B each, bucket-padded).  A budget breach
     # means serving went re-stage-shaped instead of gather-shaped.
     "proof_engine": {"h2d_bytes": 2 * MiB, "d2h_bytes": 2 * MiB},
+    # Block packing: the candidate CSR columns (element ids, weights,
+    # segment ids, precomputed word/bit planes — ≈ 26 B/entry, a
+    # backlogged mainnet pool is a few M entries) go up once per
+    # produce; the selection vector coming down is rounds × 4 B.
+    "op_pool": {"h2d_bytes": 256 * MiB, "d2h_bytes": 1 * MiB},
 }
 
 

@@ -118,6 +118,18 @@ _declare("LIGHTHOUSE_TPU_DEVICE_STATE", "bool", True,
          "Device-resident BeaconState: HBM is the hashing source of "
          "truth (0 = host incremental oracle).")
 
+# -- block production / op pool --
+_declare("LIGHTHOUSE_TPU_DEVICE_PACK", "bool", True,
+         "Fixed-shape device greedy-pack for attestation max-cover "
+         "(0 = host CELF oracle).")
+_declare("LIGHTHOUSE_TPU_PACK_JIT", "tribool", "auto",
+         "Force the jitted pack engine on/off (auto: jit iff the "
+         "backend is a real TPU; numpy rounds engine otherwise).")
+_declare("LIGHTHOUSE_TPU_SPECULATIVE_PRODUCE", "bool", True,
+         "Pre-advance the next slot's state on a COW share during the "
+         "slot tail; production adopts it iff the head is unchanged "
+         "(0 = advance serially at production time).")
+
 # -- fork choice --
 _declare("LIGHTHOUSE_TPU_DEVICE_FORKCHOICE", "bool", True,
          "Columnar device proto-array (0 = host walk oracle).")

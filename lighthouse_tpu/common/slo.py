@@ -126,6 +126,14 @@ def default_objectives(slot_seconds: float = 12.0) -> Tuple[Objective, ...]:
             description="dispatches served by the host oracle / total "
                         "dispatches"),
         Objective(
+            "block_production_ms", feed="block_production",
+            kind="latency", budget=float(slot_seconds) / 3.0,
+            percentile=0.99, severity=DEGRADED,
+            description="p99 end-to-end block production (adopt "
+                        "pre-advanced state → device pack → assemble) "
+                        "within a third of the slot — a proposer that "
+                        "misses this window forfeits the proposal"),
+        Objective(
             "proof_serve_ms", feed="proof_serve", kind="latency",
             budget=knob_float("LIGHTHOUSE_TPU_SLO_PROOF_SERVE_MS") / 1e3,
             percentile=0.99, severity=DEGRADED,
@@ -631,7 +639,13 @@ def wire_chain_feeds(engine: SloEngine, chain) -> None:
         buckets, counts, total, _sum = srv.latency_snapshot()
         return ("hist", buckets, counts, total)
 
+    def block_production():
+        buckets, counts, total, _sum = \
+            chain._slo_production_hist.snapshot()
+        return ("hist", buckets, counts, total)
+
     engine.register_feed("gossip_to_verified", gossip_to_verified)
+    engine.register_feed("block_production", block_production)
     engine.register_feed("block_import", block_import)
     engine.register_feed("shed_rate", shed_rate)
     engine.register_feed("import_failure_rate", import_failure_rate)

@@ -572,6 +572,11 @@ def _src_device_ledger() -> dict:
     return LEDGER.stage_dict()
 
 
+def _src_op_pool() -> dict:
+    from ..op_pool.device_pack import LAST_PACK_STATS
+    return LAST_PACK_STATS
+
+
 _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "block": _src_block,
     "epoch": _src_epoch,
@@ -585,6 +590,7 @@ _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "materialize": _src_materialize,
     "block_sigs": _src_block_sigs,
     "device_ledger": _src_device_ledger,
+    "op_pool": _src_op_pool,
 }
 
 

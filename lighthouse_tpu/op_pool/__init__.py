@@ -143,6 +143,13 @@ class OperationPool:
             att_epoch = att_slot // self.preset.SLOTS_PER_EPOCH
             if att_slot + self.preset.MIN_ATTESTATION_INCLUSION_DELAY > slot:
                 continue
+            if slot > att_slot + self.preset.SLOTS_PER_EPOCH:
+                # Upper inclusion bound: process_attestation enforces
+                # slot ≤ att_slot + SLOTS_PER_EPOCH, which is TIGHTER
+                # than the epoch filter below near an epoch boundary —
+                # packing such an attestation would invalidate the very
+                # block it rides in.
+                continue
             if att_epoch not in (epoch, epoch - 1):
                 continue
             want = want_cur if att_epoch == epoch else want_prev
@@ -256,12 +263,20 @@ def _pack_columnar(candidates, balances, seen_cur, seen_prev,
     """Columnar greedy max-cover — same greedy (heaviest-first, earliest
     tie-break, winners' coverage struck from the rest) as
     :func:`max_cover.maximum_cover`, expressed over flat CSR arrays feeding
-    :func:`max_cover.greedy_pack`'s packed-bitset core so a backlogged pool
-    packs in numpy time, not Python-dict time (the 100k-candidate BASELINE
+    the fixed-shape device rounds engine (:mod:`.device_pack`; the host
+    CELF :func:`max_cover.greedy_pack` core stays as the oracle behind
+    ``LIGHTHOUSE_TPU_DEVICE_PACK=0``) so a backlogged pool packs in
+    device/numpy time, not Python-dict time (the 100k-candidate BASELINE
     row-5 shape; the earlier padded (N, W) matrix form spent half its time
     materialising ~100 MB gathers).  Freshness is resolved per candidate
     epoch against the packed participation state in one flat gather.
-    Equivalence with the dict path is asserted in tests."""
+    Equivalence across all three paths is asserted in tests.
+    CSR-build / coverage / select phase timings land in the ``op_pool``
+    tracing stage source."""
+    import time as _time
+    from .device_pack import device_pack_enabled, greedy_pack_device
+
+    t0 = _time.perf_counter()
     N = len(candidates)
     ws = np.fromiter((len(s.committee) for s, _ in candidates),
                      np.int64, N)
@@ -281,6 +296,8 @@ def _pack_columnar(candidates, balances, seen_cur, seen_prev,
     attesting = np.flatnonzero(flat_bit)
     att_bounds = np.searchsorted(attesting, bounds)
     att_comm = flat_comm[attesting]
+    csr_build_ms = (_time.perf_counter() - t0) * 1e3
+    t1 = _time.perf_counter()
     is_cur = np.fromiter((cur for _, cur in candidates), bool, N)
     att_cur = np.repeat(is_cur, np.diff(att_bounds))
     seen_flat = np.empty(attesting.shape[0], dtype=bool)
@@ -293,8 +310,15 @@ def _pack_columnar(candidates, balances, seen_cur, seen_prev,
     offsets = cfs[att_bounds]
     flat_e = att_comm[fresh]
     flat_w = balances[flat_e].astype(np.int64)
-    chosen, _, _ = greedy_pack(flat_e, flat_w, offsets, balances.shape[0],
-                               limit)
+    coverage_ms = (_time.perf_counter() - t1) * 1e3
+    if device_pack_enabled():
+        chosen = greedy_pack_device(flat_e, flat_w, offsets,
+                                    balances.shape[0], limit,
+                                    csr_build_ms=csr_build_ms,
+                                    coverage_ms=coverage_ms)
+    else:
+        chosen, _, _ = greedy_pack(flat_e, flat_w, offsets,
+                                   balances.shape[0], limit)
     return [candidates[b][0] for b in chosen]
 
 

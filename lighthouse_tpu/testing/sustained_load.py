@@ -50,6 +50,8 @@ import time
 import urllib.request
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from ..api.http_api import HttpApiServer
 from ..beacon_chain import BeaconChain
 from ..common.tracing import TRACER
@@ -200,6 +202,60 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
         # Compressed-time budget: the per-message objective scales with
         # the drill slot exactly like the service's batching SLO does.
         engine.set_budget("gossip_to_verified", slot_s / 3.0)
+        # The proposer deadline compresses with the slot too: a block
+        # must be produced within the first third (mainnet's broadcast
+        # deadline) or the proposal is forfeit.
+        engine.set_budget("block_production_ms", slot_s / 3.0)
+
+        from ..validator_client.beacon_node import InProcessBeaconNode
+        bn = InProcessBeaconNode(chain)
+        production = {"produced": 0, "ms": [], "deadline_misses": [],
+                      "pack_divergence": [], "errors": []}
+
+        def _with_pack(value: str, fn):
+            # Dedicated-process driver: plain set/pop toggling, like the
+            # validate_* scripts (drills own their process env).
+            import os
+            os.environ["LIGHTHOUSE_TPU_DEVICE_PACK"] = value
+            try:
+                return fn()
+            finally:
+                os.environ.pop("LIGHTHOUSE_TPU_DEVICE_PACK", None)
+
+        def produce_lane(slot: int, check_divergence: bool) -> None:
+            """The proposer lane: the drill node IS the designated
+            proposer every slot — production runs the REAL pipeline
+            (adopt pre-advanced state → pack the pool → assemble →
+            state-root fill) and is measured against the slot/3
+            deadline.  The produced block is discarded (the harness's
+            block stays canonical: the lane measures the hot path, it
+            must not fork the drill chain).  ``check_divergence``
+            additionally packs the same pool through BOTH engines and
+            fails the drill on any selection drift — the differential
+            oracle riding the live traffic."""
+            t_p = time.monotonic()
+            try:
+                bn.produce_block(slot, b"\x00" * 96)
+            except Exception as e:  # noqa: BLE001 — scoreboard signal
+                # A production that DIED is worse than a slow one:
+                # reported distinctly so the failure names the bug, not
+                # a phantom deadline miss.
+                production["errors"].append((slot, repr(e)))
+                return
+            ms = (time.monotonic() - t_p) * 1e3
+            production["produced"] += 1
+            production["ms"].append(ms)
+            if ms > slot_s * 1e3 / 3.0:
+                production["deadline_misses"].append(slot)
+            if check_divergence:
+                st = chain.head.state
+                dev = _with_pack("1", lambda: chain.op_pool
+                                 .get_attestations(st, chain.T))
+                host = _with_pack("0", lambda: chain.op_pool
+                                  .get_attestations(st, chain.T))
+                if [bytes(a.tree_hash_root()) for a in dev] != \
+                        [bytes(a.tree_hash_root()) for a in host]:
+                    production["pack_divergence"].append(slot)
 
         def drive_slot(slot: int, t_slot: Optional[float],
                        fraction: float, with_aggs: bool,
@@ -216,6 +272,10 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
             toggle the process tracer here: the previous slot fully
             drained, so no node work is concurrent with the window."""
             chain.per_slot_task(slot)
+            # Proposer lane first — production runs at slot start on the
+            # previous head (mainnet ordering: the proposer builds
+            # before its own block arrives over gossip).
+            produce_lane(slot, check_divergence=expected is not None)
             tracing = TRACER.enabled
             TRACER.disable()
             try:
@@ -474,6 +534,21 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
                 "consumer_errors": proof_counts["errors"],
                 "server": (chain.proof_server.stats()
                            if proof_consumers > 0 else None),
+            },
+            "production": {
+                "produced": production["produced"],
+                "deadline_ms": round(slot_s * 1e3 / 3.0, 3),
+                "deadline_misses": production["deadline_misses"],
+                "pack_divergence": production["pack_divergence"],
+                "errors": production["errors"],
+                "p50_ms": round(float(np.percentile(
+                    production["ms"], 50)), 3) if production["ms"]
+                else None,
+                "p99_ms": round(float(np.percentile(
+                    production["ms"], 99)), 3) if production["ms"]
+                else None,
+                "adopted": chain._produce_adopted,
+                "serial": chain._produce_serial,
             },
             "host_fallbacks": st["bls"]["host_fallbacks"],
             "breaker": st["bls"]["breaker"],

@@ -15,17 +15,11 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ..state_transition.committees import (
-    get_beacon_committee,
-    get_beacon_proposer_index,
-    get_committee_count_per_slot,
-)
 from ..state_transition.helpers import (
     current_epoch,
     get_randao_mix,
 )
 from ..state_transition.per_block import get_expected_withdrawals
-from ..state_transition.per_slot import process_slots
 from ..types.chain_spec import ForkName
 
 
@@ -65,41 +59,27 @@ class InProcessBeaconNode:
 
     # -- duties --------------------------------------------------------------
 
-    def _epoch_state(self, epoch: int):
-        preset = self.chain.preset
-        state = self.chain.head.state
-        start = epoch * preset.SLOTS_PER_EPOCH
-        if int(state.slot) < start:
-            state = process_slots(state.copy(), start, preset,
-                                  self.chain.spec, self.chain.T)
-        return state
-
     def proposer_duties(self, epoch: int) -> List[ProposerDuty]:
-        """`DutiesService` proposer poll (`duties_service.rs`)."""
+        """`DutiesService` proposer poll (`duties_service.rs`) — served
+        from the chain's pre-materialized duty cache; the lookahead
+        usually primed it during the slot tail, so this is a list read,
+        not an epoch of shuffles."""
         preset = self.chain.preset
-        state = self._epoch_state(epoch)
-        out = []
-        for slot in range(epoch * preset.SLOTS_PER_EPOCH,
-                          (epoch + 1) * preset.SLOTS_PER_EPOCH):
-            out.append(ProposerDuty(
-                slot, get_beacon_proposer_index(state, preset, slot=slot)))
-        return out
+        cache = self.chain.duty_cache(epoch)
+        first = epoch * preset.SLOTS_PER_EPOCH
+        return [ProposerDuty(first + k, cache.proposers[k])
+                for k in range(preset.SLOTS_PER_EPOCH)]
 
     def attester_duties(self, epoch: int,
                         indices: Sequence[int]) -> List[AttesterDuty]:
-        preset = self.chain.preset
-        state = self._epoch_state(epoch)
-        want = set(int(i) for i in indices)
+        cache = self.chain.duty_cache(epoch)
+        n = len(self.chain.head.state.validators)
         out = []
-        for slot in range(epoch * preset.SLOTS_PER_EPOCH,
-                          (epoch + 1) * preset.SLOTS_PER_EPOCH):
-            n_comm = get_committee_count_per_slot(state, epoch, preset)
-            for ci in range(n_comm):
-                committee = get_beacon_committee(state, slot, ci, preset)
-                for pos, vi in enumerate(committee):
-                    if int(vi) in want:
-                        out.append(AttesterDuty(
-                            slot, ci, pos, len(committee), int(vi)))
+        for vi in indices:
+            duty = cache.attester_duty(int(vi), n)
+            if duty is not None:
+                slot, ci, pos, length = duty
+                out.append(AttesterDuty(slot, ci, pos, length, int(vi)))
         return out
 
     def liveness(self, epoch: int, indices: Sequence[int]) -> List[bool]:
@@ -132,11 +112,16 @@ class InProcessBeaconNode:
                       graffiti: bytes = b"\x00" * 32):
         """Unsigned block assembly from the pool + mock payload
         (`produce_block_on_state`, `beacon_chain.rs:4133`; payload via the
-        MockExecutionLayer-style generator)."""
+        MockExecutionLayer-style generator).  The hot path is
+        `produce_block_components`: adopt the speculatively pre-advanced
+        state → pack the pool on device → assemble; the whole assembly
+        is timed into the ``block_production_ms`` SLO."""
+        import time as _time
         chain = self.chain
         preset, spec, T = chain.preset, chain.spec, chain.T
-        parts = chain.produce_block_on_state(
-            chain.head.state.copy(), slot, randao_reveal, graffiti)
+        t0 = _time.perf_counter()
+        parts = chain.produce_block_components(slot, randao_reveal,
+                                               graffiti)
         state = parts["state"]
         fork = spec.fork_name_at_epoch(slot // preset.SLOTS_PER_EPOCH)
         body_kw = dict(
@@ -174,6 +159,7 @@ class InProcessBeaconNode:
         process_block(scratch, dummy, fork, preset, spec, T,
                       strategy=SignatureStrategy.NO_VERIFICATION)
         block.state_root = scratch.tree_hash_root()
+        chain.note_block_production(_time.perf_counter() - t0)
         return block
 
     def _payload(self, state, fork: ForkName):

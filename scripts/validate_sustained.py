@@ -14,6 +14,9 @@ on any violated invariant:
   box overload, reported distinctly from loss: such a slot's loss
   check cannot certify either way, so the run is not green)
 - a declared objective with no computed attainment (a dead feed)
+- a proposer-lane block production that missed the slot/3 deadline, or
+  a device-vs-host pack divergence on a live pool (the differential
+  oracle riding the drill traffic)
 - a warm-slot device-transfer budget violation (the device ledger's
   per-slot per-subsystem byte deltas against ``WARM_SLOT_BUDGET`` —
   a full-column host round-trip inside a measured slot fails the run)
@@ -139,6 +142,26 @@ def main(argv=None) -> int:
                 for r in board["device_budget"]["violations"]]
         failures.append("warm-slot transfer budget violated: "
                         + "; ".join(viol))
+    production = board["production"]
+    if production["produced"] == 0:
+        failures.append("proposer lane produced no blocks")
+    if production["errors"]:
+        failures.append(
+            f"block production raised: {production['errors'][:4]}")
+    if production["deadline_misses"]:
+        # The proposer forfeits a proposal that misses the slot/3
+        # broadcast deadline — a miss is a hard failure, not a latency
+        # statistic.
+        failures.append(
+            f"block production missed the {production['deadline_ms']} ms"
+            f" deadline at slots {production['deadline_misses']} "
+            f"(p99 {production['p99_ms']} ms)")
+    if production["pack_divergence"]:
+        # The device greedy-pack and the host CELF oracle disagreed on
+        # a live pool — a correctness bug, never acceptable.
+        failures.append(
+            f"device/host pack divergence at slots "
+            f"{production['pack_divergence']}")
     transitions = board["health"]["transitions"]
     if not args.faults:
         if transitions or board["health"]["state"] != "healthy":
@@ -176,6 +199,7 @@ def main(argv=None) -> int:
         "transitions": [(t["from"], t["to"], t["reasons"])
                         for t in transitions],
         "host_fallbacks": board["host_fallbacks"],
+        "production": production,
         "proof": board.get("proof"),
         "device_budget_ok": board["device_budget"]["ok"],
         "device_budget_attainment": board["device_budget"]["attainment"],

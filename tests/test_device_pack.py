@@ -1,0 +1,317 @@
+"""Device greedy-pack + speculative production: differential suite.
+
+Three layers, all against exact oracles:
+
+1. **Pack differentials** — randomized CSR pools (duplicate aggregates,
+   fully-overlapping and disjoint committees, tie-heavy weights, empty
+   and singleton candidates, growth across pad buckets) packed by the
+   device rounds engines (numpy AND jit-on-host) must select the SAME
+   candidates in the SAME order as the host CELF oracle: lazy-greedy
+   with an exact priority queue ≡ eager per-round argmax, including the
+   (max weight, earliest index) tie-break.
+2. **Speculative adoption fuzz** — when the head is unchanged at
+   production time the adopted pre-advanced state must be bit-identical
+   to a serial advance; when the head moved the pre-advance is
+   discarded and nothing bleeds between states.
+3. **Duty caches** — the pre-materialized proposer/attester lookups
+   must equal the per-request shuffle loops they replaced.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.op_pool.device_pack import (
+    _bucket,
+    device_pack_enabled,
+    greedy_pack_device,
+    modeled_pack_ms,
+)
+from lighthouse_tpu.op_pool.max_cover import greedy_pack
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    B.set_backend("fake")
+    yield
+    B.set_backend("python")
+
+
+def _random_pool(rng, n_cands, n_validators=512):
+    """CSR pool biased to the adversarial corners (mirrors
+    scripts/validate_block_production.py)."""
+    segments = []
+    shared = rng.choice(n_validators, 64, replace=False)
+    for _ in range(n_cands):
+        kind = rng.integers(0, 10)
+        if kind == 0 and segments:
+            segments.append(segments[rng.integers(0, len(segments))])
+        elif kind == 1:
+            segments.append(np.empty(0, np.int64))
+        elif kind == 2:
+            segments.append(rng.choice(n_validators, 1).astype(np.int64))
+        elif kind <= 6:
+            size = int(rng.integers(1, 17))
+            segments.append(np.sort(rng.choice(
+                shared, size, replace=False)).astype(np.int64))
+        else:
+            size = int(rng.integers(1, 17))
+            segments.append(rng.choice(
+                n_validators, size, replace=False).astype(np.int64))
+    offsets = np.zeros(len(segments) + 1, np.int64)
+    np.cumsum([s.size for s in segments], out=offsets[1:])
+    flat_e = (np.concatenate(segments) if segments
+              else np.empty(0, np.int64))
+    balances = rng.choice(np.array([31, 32, 2048], np.int64) * 10**9,
+                          n_validators)
+    return flat_e, balances[flat_e], offsets
+
+
+# ---------------------------------------------------------------------------
+# 1. Pack differentials
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ["numpy", "jit"])
+def test_pack_matches_celf_randomized(engine):
+    rng = np.random.default_rng(7)
+    sizes = [0, 1, 2, 3, 5, 9, 17, 40] if engine == "jit" \
+        else [0, 1, 2, 3, 5, 9, 17, 40, 90, 200]
+    for n_cands in sizes:
+        flat_e, flat_w, offsets = _random_pool(rng, n_cands)
+        host, _, _ = greedy_pack(flat_e, flat_w, offsets, 512, 16)
+        dev = greedy_pack_device(flat_e, flat_w, offsets, 512, 16,
+                                 engine=engine)
+        assert list(dev) == list(host), \
+            f"engine={engine} n_cands={n_cands}"
+
+
+def test_pack_ties_break_on_earliest_index():
+    # Two identical candidates + a disjoint lighter one: CELF picks the
+    # EARLIER duplicate first, the lighter one second, and never the
+    # now-worthless second duplicate.
+    flat_e = np.array([5, 6, 7, 5, 6, 7, 9], np.int64)
+    flat_w = np.array([32, 32, 32, 32, 32, 32, 31], np.int64)
+    offsets = np.array([0, 3, 6, 7], np.int64)
+    host, _, _ = greedy_pack(flat_e, flat_w, offsets, 16, 4)
+    assert host == [0, 2]
+    for engine in ("numpy", "jit"):
+        assert list(greedy_pack_device(flat_e, flat_w, offsets, 16, 4,
+                                       engine=engine)) == host
+
+
+def test_pack_growth_across_pad_buckets():
+    # The same prefix pool must select identically as the pool grows
+    # across bucket boundaries (padding is masked out, never scored).
+    rng = np.random.default_rng(11)
+    flat_e, flat_w, offsets = _random_pool(rng, 140)
+    for cut in (7, 8, 9, 63, 64, 65, 140):  # straddle pow2 buckets
+        o = offsets[:cut + 1]
+        e, w = flat_e[:o[-1]], flat_w[:o[-1]]
+        host, _, _ = greedy_pack(e, w, o, 512, 8)
+        for engine in ("numpy", "jit"):
+            assert list(greedy_pack_device(e, w, o, 512, 8,
+                                           engine=engine)) == host
+
+
+def test_pack_empty_and_singleton_pools():
+    empty = np.empty(0, np.int64)
+    for engine in ("numpy", "jit"):
+        assert greedy_pack_device(empty, empty, np.zeros(1, np.int64),
+                                  64, 8, engine=engine) == []
+        # Singleton pool with one empty candidate: nothing packable.
+        assert greedy_pack_device(empty, empty, np.zeros(2, np.int64),
+                                  64, 8, engine=engine) == []
+        one = greedy_pack_device(np.array([3], np.int64),
+                                 np.array([32], np.int64),
+                                 np.array([0, 1], np.int64),
+                                 64, 8, engine=engine)
+        assert one == [0]
+
+
+def test_bucket_and_model_shapes():
+    assert _bucket(0) == 8 and _bucket(8) == 8 and _bucket(9) == 16
+    assert _bucket(100, floor=64) == 128
+    assert modeled_pack_ms(0, 0, 0) == 0.0
+    # Monotone in every axis at fixed others.
+    assert modeled_pack_ms(10**6, 10**5, 128) > \
+        modeled_pack_ms(10**5, 10**5, 128)
+
+
+def test_knob_routes_pool_packing(monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_PACK", "0")
+    assert not device_pack_enabled()
+    monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_PACK", "1")
+    assert device_pack_enabled()
+
+
+def test_get_attestations_identical_on_both_knob_settings(monkeypatch):
+    # End-to-end through the pool's columnar path: force both engines
+    # over the SAME pool and compare the packed attestations.
+    from lighthouse_tpu.op_pool import bench_pack_attestations
+    packed = {}
+    for knob in ("0", "1"):
+        monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_PACK", knob)
+        _ms, count = bench_pack_attestations(4096, n_validators=1 << 14,
+                                             seed=3)
+        packed[knob] = count
+    assert packed["0"] == packed["1"] > 0
+
+
+def test_pack_stage_split_registered():
+    from lighthouse_tpu.common import tracing
+    rng = np.random.default_rng(5)
+    flat_e, flat_w, offsets = _random_pool(rng, 30)
+    greedy_pack_device(flat_e, flat_w, offsets, 512, 8, engine="numpy")
+    split = tracing.stage_split("op_pool")
+    assert split["engine"] == "numpy"
+    assert split["candidates"] == 30
+    assert "select_rounds_ms" in split
+
+
+# ---------------------------------------------------------------------------
+# 2. Speculative adoption
+# ---------------------------------------------------------------------------
+
+def _make_chain(n_validators=16):
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.store import HotColdDB
+    h = StateHarness(n_validators=n_validators, preset=MINIMAL)
+    hdr = h.state.latest_block_header.copy()
+    hdr.state_root = h.state.tree_hash_root()
+    chain = BeaconChain(store=HotColdDB.memory(h.preset, h.spec, h.T),
+                        genesis_state=h.state.copy(),
+                        genesis_block_root=hdr.tree_hash_root(),
+                        preset=h.preset, spec=h.spec, T=h.T)
+    return h, chain
+
+
+def _import_block(h, chain, slot):
+    signed = h.build_block(slot=slot)
+    h.apply_block(signed)
+    chain.per_slot_task(slot)
+    chain.process_block(signed, is_timely=True)
+    return signed
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_speculative_adoption_bit_identical_to_serial(seed):
+    rng = np.random.default_rng(seed)
+    h, chain = _make_chain()
+    for slot in range(1, 3 + int(rng.integers(0, 4))):
+        _import_block(h, chain, slot)
+    head = chain.head
+    target = head.slot + 1
+    # The 3/4-slot lookahead primes the pre-advance for the next slot.
+    chain.on_three_quarters_slot(head.slot)
+    assert (head.root, target) in chain._advanced_states
+    parts_spec = chain.produce_block_components(target, b"\x00" * 96)
+    assert chain._produce_adopted == 1 and chain._produce_serial == 0
+    # Serial oracle: same production with the pre-advance knob off.
+    os.environ["LIGHTHOUSE_TPU_SPECULATIVE_PRODUCE"] = "0"
+    try:
+        parts_serial = chain.produce_block_components(target,
+                                                      b"\x00" * 96)
+    finally:
+        os.environ.pop("LIGHTHOUSE_TPU_SPECULATIVE_PRODUCE", None)
+    assert chain._produce_serial == 1
+    assert bytes(parts_spec["state"].tree_hash_root()) == \
+        bytes(parts_serial["state"].tree_hash_root())
+    assert parts_spec["proposer_index"] == parts_serial["proposer_index"]
+    assert parts_spec["parent_root"] == parts_serial["parent_root"]
+
+
+def test_speculative_discard_on_head_change():
+    h, chain = _make_chain()
+    _import_block(h, chain, 1)
+    old_head = chain.head
+    chain.on_three_quarters_slot(1)  # primes (old_head.root, 2)
+    primed = chain._advanced_states[(old_head.root, 2)]
+    primed_root_before = bytes(primed.tree_hash_root())
+    # A block lands at slot 2: the head the pre-advance was built on is
+    # gone, so production at slot 3 must NOT adopt the stale advance.
+    _import_block(h, chain, 2)
+    assert chain.head.root != old_head.root
+    parts = chain.produce_block_components(3, b"\x00" * 96)
+    assert chain._produce_serial == 1 and chain._produce_adopted == 0
+    assert int(parts["state"].slot) == 3
+    assert parts["parent_root"] == chain.head.root
+    # No state bleed: the discarded pre-advance is untouched.
+    assert bytes(primed.tree_hash_root()) == primed_root_before
+
+
+def test_adoption_copy_isolates_the_cached_state():
+    # produce must work on a COPY of the primed state — mutating the
+    # produced state must not corrupt the cache entry another consumer
+    # (state_for_attestation, duties) may still read.
+    h, chain = _make_chain()
+    _import_block(h, chain, 1)
+    chain.on_three_quarters_slot(1)
+    cached = chain._advanced_states[(chain.head.root, 2)]
+    before = bytes(cached.tree_hash_root())
+    parts = chain.produce_block_components(2, b"\x00" * 96)
+    parts["state"].slot = 9999  # caller-side mutation
+    assert bytes(cached.tree_hash_root()) == before
+
+
+# ---------------------------------------------------------------------------
+# 3. Duty caches
+# ---------------------------------------------------------------------------
+
+def test_duty_cache_matches_shuffle_oracle():
+    from lighthouse_tpu.state_transition.committees import (
+        get_beacon_committee,
+        get_beacon_proposer_index,
+        get_committee_count_per_slot,
+    )
+    h, chain = _make_chain(n_validators=32)
+    _import_block(h, chain, 1)
+    spe = chain.preset.SLOTS_PER_EPOCH
+    for epoch in (0, 1):
+        cache = chain.duty_cache(epoch)
+        state = chain.head.state.copy()
+        from lighthouse_tpu.state_transition.per_slot import process_slots
+        if int(state.slot) < epoch * spe:
+            state = process_slots(state, epoch * spe, chain.preset,
+                                  chain.spec, chain.T)
+        # Proposers: cached list vs per-slot shuffle.
+        for k, slot in enumerate(range(epoch * spe, (epoch + 1) * spe)):
+            assert cache.proposer_at(slot) == get_beacon_proposer_index(
+                state, chain.preset, slot=slot)
+        # Attester duties: cached inverse lookup vs committee walk.
+        oracle = {}
+        for slot in range(epoch * spe, (epoch + 1) * spe):
+            n_comm = get_committee_count_per_slot(state, epoch,
+                                                  chain.preset)
+            for ci in range(n_comm):
+                committee = get_beacon_committee(state, slot, ci,
+                                                 chain.preset)
+                for pos, vi in enumerate(committee):
+                    oracle[int(vi)] = (slot, ci, pos, len(committee))
+        n = len(chain.head.state.validators)
+        for vi in range(n):
+            assert cache.attester_duty(vi, n) == oracle.get(vi), \
+                f"epoch={epoch} validator={vi}"
+
+
+def test_duty_cache_primed_by_slot_tail_and_bounded():
+    h, chain = _make_chain()
+    _import_block(h, chain, 1)
+    chain.on_three_quarters_slot(1)
+    # The lookahead primed the duty cache for slot 2's epoch without a
+    # duties request ever arriving.
+    spe = chain.preset.SLOTS_PER_EPOCH
+    assert (chain.head.root, 2 // spe) in chain._duty_caches
+    for epoch in range(2):
+        chain.duty_cache(epoch)
+    assert len(chain._duty_caches) <= chain.DUTY_CACHE_SIZE
+
+
+def test_duty_cache_rejects_unprimeable_epoch():
+    h, chain = _make_chain()
+    _import_block(h, chain, 1)
+    with pytest.raises(ValueError):
+        chain.duty_cache(10**9 // int(chain.preset.SLOTS_PER_EPOCH))

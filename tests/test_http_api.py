@@ -169,6 +169,64 @@ def test_attester_and_sync_duties_routes(api):
     assert sync["data"][0]["validator_sync_committee_indices"]
 
 
+def test_duties_served_from_cache(api):
+    h, chain, srv = api
+    out = _get(srv, "/eth/v1/validator/duties/proposer/0")
+    # The request materialized the (head, epoch) duty cache …
+    key = (chain.head.root, 0)
+    assert key in chain._duty_caches
+    # … and repeat requests are served FROM it — no shuffle recompute.
+    import lighthouse_tpu.beacon_chain.chain as C
+    orig = C.get_beacon_proposer_index
+
+    def boom(*a, **kw):
+        raise AssertionError("cache miss: proposer shuffle recomputed")
+
+    C.get_beacon_proposer_index = boom
+    try:
+        again = _get(srv, "/eth/v1/validator/duties/proposer/0")
+        att = _post(srv, "/eth/v1/validator/duties/attester/0",
+                    ["0", "1"])
+    finally:
+        C.get_beacon_proposer_index = orig
+    assert again["data"] == out["data"]
+    assert len(att["data"]) == 2
+
+
+def test_duties_error_shapes(api):
+    h, chain, srv = api
+    # 400: epoch beyond the wall-clock gate, JSON error envelope.
+    try:
+        _get(srv, "/eth/v1/validator/duties/proposer/999")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        body = json.loads(e.read())
+        assert body["code"] == 400 and "epoch" in body["message"]
+    # 400: non-integer epoch segment.
+    try:
+        _get(srv, "/eth/v1/validator/duties/proposer/nope")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # 400: attester duties beyond the gate (POST).
+    try:
+        _post(srv, "/eth/v1/validator/duties/attester/999", ["0"])
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        body = json.loads(e.read())
+        assert body["code"] == 400
+    # 404: unknown validator duties sub-route, JSON envelope.
+    try:
+        _get(srv, "/eth/v1/validator/duties/unknown/0")
+        assert False
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+        body = json.loads(e.read())
+        assert body["code"] == 404
+
+
 def test_attestation_data_and_pool_submit(api):
     h, chain, srv = api
     data = _get(srv, "/eth/v1/validator/attestation_data"

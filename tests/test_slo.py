@@ -360,7 +360,7 @@ def test_slo_route_reports_every_objective(api_server):
     names = {o["name"] for o in data["objectives"]}
     assert names == {"gossip_to_verified", "block_import", "shed_rate",
                      "import_failure_rate", "host_fallback_rate",
-                     "proof_serve_ms"}
+                     "proof_serve_ms", "block_production_ms"}
     rows = {o["name"]: o for o in data["objectives"]}
     # the block import above fed the record-time histogram
     assert rows["block_import"]["slow"]["events"] >= 1
