@@ -186,6 +186,7 @@ class BeaconChain:
             genesis_block_root: genesis_state.copy()}
         self._advanced_states: dict = {}
         self._duty_caches: dict = {}
+        self._duty_prime_errors: dict = {}
         from .attester_cache import (
             AttesterCache, BlockTimesCache, EarlyAttesterCache)
         self.attester_cache = AttesterCache()
@@ -394,6 +395,7 @@ class BeaconChain:
         chain._states_by_block = {}
         chain._advanced_states = {}
         chain._duty_caches = {}
+        chain._duty_prime_errors = {}
         from .attester_cache import (
             AttesterCache, BlockTimesCache, EarlyAttesterCache)
         chain.attester_cache = AttesterCache()
@@ -597,8 +599,16 @@ class BeaconChain:
             proposers = [
                 get_beacon_proposer_index(state, self.preset, slot=s)
                 for s in range(first, first + spe)]
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — must not kill a timer tick
+            # Remember WHY so duty_cache can surface the cause — a
+            # server-side bug here must not masquerade as a plain
+            # out-of-range 400 with no trace of the real failure.
+            while len(self._duty_prime_errors) >= self.DUTY_CACHE_SIZE:
+                self._duty_prime_errors.pop(
+                    next(iter(self._duty_prime_errors)))
+            self._duty_prime_errors[key] = repr(e)
             return
+        self._duty_prime_errors.pop(key, None)
         while len(self._duty_caches) >= self.DUTY_CACHE_SIZE:
             self._duty_caches.pop(next(iter(self._duty_caches)))
         self._duty_caches[key] = DutyCache(head_root, int(epoch), first,
@@ -618,14 +628,18 @@ class BeaconChain:
         spe = self.preset.SLOTS_PER_EPOCH
         first = int(epoch) * spe
         state = head.state
-        head_epoch = int(state.slot) // spe
-        if int(epoch) > head_epoch + 1:
-            # Same amplification gate as the HTTP duties routes: a
-            # far-future epoch would drive process_slots for billions
-            # of slots to build its shuffle.
+        now_epoch = max(self.current_slot(), int(head.slot)) // spe
+        if int(epoch) > now_epoch + 1:
+            # Same amplification gate as the HTTP duties routes — bound
+            # by the WALL-CLOCK epoch, not the head's: when the head
+            # lags the clock (quiet chain, syncing) current-epoch duties
+            # must still be served or the VC never learns it proposes
+            # (the head-gated deadlock the route docstring warns about).
+            # A lagging head pays one memoized process_slots advance
+            # below, not a shuffle per request.
             raise ValueError(
-                f"duties unavailable for epoch {epoch}: head epoch "
-                f"{head_epoch} (served: ≤ {head_epoch + 1})")
+                f"duties unavailable for epoch {epoch}: wall-clock "
+                f"epoch {now_epoch} (served: ≤ {now_epoch + 1})")
         if int(state.slot) < first:
             akey = (head.root, first)
             advanced = self._advanced_states.get(akey)
@@ -637,10 +651,12 @@ class BeaconChain:
             state = advanced
         self._prime_duties(head.root, state, int(epoch))
         cache = self._duty_caches.get(key)
-        if cache is None:  # prime failed (epoch outside cache range)
+        if cache is None:  # prime failed — surface the recorded cause
+            cause = self._duty_prime_errors.get(key)
             raise ValueError(
                 f"duties unavailable for epoch {epoch} at head slot "
-                f"{int(state.slot)}")
+                f"{int(state.slot)}"
+                + (f" ({cause})" if cause else ""))
         return cache
 
     # -- state lookup --------------------------------------------------------

@@ -296,6 +296,7 @@ class ProofServer:
         self._lock = threading.Lock()
         self._engines: "OrderedDict[bytes, DeviceProofEngine]" = \
             OrderedDict()
+        self._building: Dict[bytes, threading.Event] = {}
         self._cache_states = cache_states
         self._batches: Dict[bytes, _Batch] = {}
         self.requests = 0
@@ -345,20 +346,39 @@ class ProofServer:
 
     def _engine_for(self, state) -> Tuple[DeviceProofEngine, bytes]:
         root = bytes(state.tree_hash_root())
-        with self._lock:
-            eng = self._engines.get(root)
-            if eng is not None:
-                self._engines.move_to_end(root)
+        while True:
+            with self._lock:
+                eng = self._engines.get(root)
+                if eng is not None:
+                    self._engines.move_to_end(root)
+                    return eng, root
+                # Per-root build dedup: concurrent first requests for
+                # the same state root must pay ONE H2D materialization,
+                # not one each (the losers' trees would be discarded by
+                # the LRU insert but still billed to the ledger budget).
+                ev = self._building.get(root)
+                if ev is None:
+                    ev = self._building[root] = threading.Event()
+                    builder = True
+                else:
+                    builder = False
+            if not builder:
+                ev.wait()
+                continue  # re-check the cache (or take over on failure)
+            try:
+                plane = _field_plane(self._field_roots(state))
+                with LEDGER.attribute("proof_engine"):
+                    tree = DeviceTree.from_host_leaves(plane)
+                eng = DeviceProofEngine(tree)
+                with self._lock:
+                    self._engines[root] = eng
+                    while len(self._engines) > self._cache_states:
+                        self._engines.popitem(last=False)
                 return eng, root
-        plane = _field_plane(self._field_roots(state))
-        with LEDGER.attribute("proof_engine"):
-            tree = DeviceTree.from_host_leaves(plane)
-        eng = DeviceProofEngine(tree)
-        with self._lock:
-            self._engines[root] = eng
-            while len(self._engines) > self._cache_states:
-                self._engines.popitem(last=False)
-        return eng, root
+            finally:
+                with self._lock:
+                    del self._building[root]
+                ev.set()
 
     # -- micro-batching ------------------------------------------------------
 

@@ -213,14 +213,23 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
                       "pack_divergence": [], "errors": []}
 
         def _with_pack(value: str, fn):
-            # Dedicated-process driver: plain set/pop toggling, like the
-            # validate_* scripts (drills own their process env).
+            # Restore the operator's setting (or its absence) afterwards
+            # — the knob steers the whole drill, not just this call.
+            # The prior value is read through the registry's raw
+            # accessor (knob-registry invariant: env reads live in
+            # common/knobs.py only; writes are the drill's to make).
             import os
+
+            from ..common.knobs import _raw
+            prior = _raw("LIGHTHOUSE_TPU_DEVICE_PACK")
             os.environ["LIGHTHOUSE_TPU_DEVICE_PACK"] = value
             try:
                 return fn()
             finally:
-                os.environ.pop("LIGHTHOUSE_TPU_DEVICE_PACK", None)
+                if prior is None:
+                    os.environ.pop("LIGHTHOUSE_TPU_DEVICE_PACK", None)
+                else:
+                    os.environ["LIGHTHOUSE_TPU_DEVICE_PACK"] = prior
 
         def produce_lane(slot: int, check_divergence: bool) -> None:
             """The proposer lane: the drill node IS the designated

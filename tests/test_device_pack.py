@@ -315,3 +315,36 @@ def test_duty_cache_rejects_unprimeable_epoch():
     _import_block(h, chain, 1)
     with pytest.raises(ValueError):
         chain.duty_cache(10**9 // int(chain.preset.SLOTS_PER_EPOCH))
+
+
+def test_duty_cache_serves_clock_epoch_with_lagging_head():
+    # Regression: a head ≥2 epochs behind the wall clock (quiet chain /
+    # syncing node) must still serve current-epoch duties — gating on
+    # the HEAD epoch would 400 the VC forever, so it never learns it
+    # proposes and the chain never unsticks (the duties deadlock the
+    # HTTP route docstring warns about).
+    h, chain = _make_chain()
+    _import_block(h, chain, 1)
+    spe = int(chain.preset.SLOTS_PER_EPOCH)
+    chain.per_slot_task(3 * spe)  # clock ticks on, no blocks arrive
+    cache = chain.duty_cache(3)
+    assert len(cache.proposers) == spe
+    # The far-future amplification gate still holds past clock+1.
+    with pytest.raises(ValueError):
+        chain.duty_cache(10)
+
+
+def test_duty_cache_error_names_prime_failure(monkeypatch):
+    # A server-side failure while priming must surface its cause in the
+    # duty_cache error, not masquerade as a bare out-of-range 400.
+    h, chain = _make_chain()
+    _import_block(h, chain, 1)
+    chain._duty_caches.clear()
+    from lighthouse_tpu.state_transition import committees
+
+    def boom(*a, **k):
+        raise RuntimeError("committee cache bug")
+
+    monkeypatch.setattr(committees, "get_committee_cache", boom)
+    with pytest.raises(ValueError, match="committee cache bug"):
+        chain.duty_cache(0)

@@ -236,6 +236,48 @@ def test_concurrent_requests_coalesce(chain):
         assert branch == oracle[g - width]
 
 
+def test_cold_concurrent_requests_materialize_one_tree(chain,
+                                                       monkeypatch):
+    # Concurrent FIRST requests for the same state root must share one
+    # H2D tree build — the losers wait on the builder instead of each
+    # paying a full materialization that the LRU then discards.
+    import time
+
+    from lighthouse_tpu.ops import device_tree as dt
+    h, c = chain
+    state = c.head.state
+    srv = ProofServer(c, window_ms=60.0, max_batch=1024)
+    width = _next_pow2(len(type(state).FIELDS))
+    builds = []
+    real = dt.DeviceTree.from_host_leaves.__func__
+
+    def counting(cls, leaves):
+        builds.append(1)
+        time.sleep(0.05)  # widen the build race window
+        return real(cls, leaves)
+
+    monkeypatch.setattr(dt.DeviceTree, "from_host_leaves",
+                        classmethod(counting))
+    start = threading.Barrier(6)
+    errors = []
+
+    def worker(k):
+        try:
+            start.wait(timeout=10)
+            srv.state_proof(state, [width + k % 4])
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert len(builds) == 1
+
+
 def test_field_layer_cache_populated(chain):
     h, c = chain
     state = c.head.state
