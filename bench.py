@@ -118,6 +118,10 @@ import numpy as np
 BLST_EST_MS_PER_SET = 0.7      # single-core native estimate (see docstring)
 BLOCK_SIGS_MODELED_RATE = 1964.9  # measured flagship sets/s (BENCH r5) —
 #   the single-chip modeled-device rate of the block_with_sigs row
+DEVICE_ROOT_MODELED_MS = 15.48  # measured device-resident incremental
+#   state root (BENCH r5 state_root_incremental_ms) — the per-slot
+#   device program the serial replay oracle pays and the batched
+#   window collapses to ONE boundary launch.
 BLOCK_SIGS_MESH_RATE = 9900.0  # projected 8-chip mesh-sharded sets/s
 #   (dryrun_multichip stage model, BENCH r5) — the sharded path the
 #   block batch actually dispatches through on a pod
@@ -1506,6 +1510,143 @@ def _restart_recovery_bench() -> dict:
     return out
 
 
+def _epoch_replay_bench() -> dict:
+    """Epoch-batched replay row (batched-replay PR): the serial
+    ``BlockReplayer`` (per-block import — the catch-up oracle) vs the
+    ``EpochReplayer`` window (known state roots + ONE boundary root)
+    at window sizes {32, 64, 128} on a 64-validator MINIMAL chain.
+
+    The HEADLINE 64-block known-root shape models the device-resident
+    root engine at the measured flagship rate
+    (``DEVICE_ROOT_MODELED_MS`` = BENCH r5 ``state_root_incremental_ms``
+    — the sleep releases the GIL, same discipline as the block-sigs
+    row): the serial path charges one device root program per slot via
+    its ``state_root_fn``; the batched path looks known roots up for
+    free and charges ONE boundary program.  The pure-host window table
+    rides along (``epoch_replay_host`` — there the incremental tree
+    cache bounds the differential to the dirty-chunk hash per block),
+    as does the ``sigs`` shape: the window's signature sets in ONE
+    dispatcher batch against the modeled sleeping BLS backend vs
+    per-block synchronous verifies.  Host-only (`--host-only`
+    survivable)."""
+    from lighthouse_tpu.common import tracing
+    from lighthouse_tpu.crypto import bls as B
+    from lighthouse_tpu.state_transition import EpochReplayer
+    from lighthouse_tpu.state_transition.block_replayer import BlockReplayer
+    from lighthouse_tpu.state_transition.per_block import SignatureStrategy
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.presets import MINIMAL
+
+    class _ModeledBackend:
+        """Sleeps the modeled device time per batch, then accepts —
+        the sleep releases the GIL, so the window dispatch genuinely
+        overlaps the boundary hash."""
+        name = "modeled"
+
+        def verify_signature_sets(self, sets):
+            time.sleep(len(sets) / BLOCK_SIGS_MODELED_RATE)
+            return True
+
+        def verify(self, signature, pubkeys, message):
+            return True
+
+        def aggregate_verify(self, signature, pubkeys, messages):
+            return True
+
+    prev_backend = next(
+        k for k, v in B._BACKENDS.items() if v is B.get_backend())
+    B.register_backend("modeled", _ModeledBackend())
+    B.set_backend("fake")
+    out: dict = {}
+    try:
+        h = StateHarness(n_validators=64, preset=MINIMAL)
+        genesis = h.state.copy()
+        for _ in range(128):
+            h.apply_block(h.build_block(),
+                          strategy=SignatureStrategy.NO_VERIFICATION)
+
+        def serial_s(blocks, root_fn=None) -> float:
+            rep = BlockReplayer(genesis.copy(), h.preset, h.spec, h.T,
+                                strategy=SignatureStrategy.NO_VERIFICATION,
+                                state_root_fn=root_fn)
+            t0 = time.perf_counter()
+            rep.apply_blocks(blocks)
+            return time.perf_counter() - t0
+
+        def batched_s(blocks, verify: bool) -> float:
+            rep = EpochReplayer(genesis.copy(), h.preset, h.spec, h.T,
+                                verify_signatures=verify)
+            t0 = time.perf_counter()
+            rep.apply_window(blocks)
+            return time.perf_counter() - t0
+
+        def serial_sigs_s(blocks) -> float:
+            rep = BlockReplayer(genesis.copy(), h.preset, h.spec, h.T,
+                                strategy=SignatureStrategy.VERIFY_BULK)
+            t0 = time.perf_counter()
+            rep.apply_blocks(blocks)
+            return time.perf_counter() - t0
+
+        windows: dict = {}
+        for n in (32, 64, 128):
+            blocks = h.blocks[:n]
+            ser = min(serial_s(blocks) for _ in range(2))
+            bat = min(batched_s(blocks, False) for _ in range(2))
+            windows[str(n)] = {
+                "serial_blocks_per_s": round(n / ser, 1),
+                "batched_blocks_per_s": round(n / bat, 1),
+                "speedup": round(ser / bat, 2),
+            }
+            if n == 64:
+                # Stage decomposition of the window, via the ONE
+                # adapter surface (stage-source rule).
+                out["epoch_replay_stage_split"] = {
+                    k: v for k, v in
+                    tracing.stage_split("replay").items()
+                    if not isinstance(v, str)}
+        out["epoch_replay_host"] = windows
+
+        # HEADLINE: the 64-block known-root shape at the modeled
+        # device-resident root rate.  The serial oracle's per-slot root
+        # lands on the device engine (one program per slot, measured
+        # latency); the batched window's known roots are free lookups
+        # and ONE boundary program closes the window.
+        blocks = h.blocks[:64]
+        claims = {int(b.message.slot): bytes(b.message.state_root)
+                  for b in blocks}
+
+        def device_root_fn(slot):
+            time.sleep(DEVICE_ROOT_MODELED_MS / 1e3)
+            return claims.get(int(slot))
+
+        ser = min(serial_s(blocks, device_root_fn) for _ in range(2))
+        bat = min(batched_s(blocks, False)
+                  for _ in range(2)) + DEVICE_ROOT_MODELED_MS / 1e3
+        out.update({
+            "epoch_replay_blocks_per_s": round(64 / bat, 1),
+            "epoch_replay_serial_blocks_per_s": round(64 / ser, 1),
+            "epoch_replay_speedup_64": round(ser / bat, 2),
+            "epoch_replay_device_root_modeled_ms": DEVICE_ROOT_MODELED_MS,
+        })
+
+        # Signature-on shape: the 64-block window's sets through ONE
+        # dispatcher batch (modeled sleeping device) vs per-block
+        # synchronous verifies at the same modeled rate.
+        B.set_backend("modeled")
+        blocks = h.blocks[:64]
+        sig_ser = min(serial_sigs_s(blocks) for _ in range(2))
+        sig_bat = min(batched_s(blocks, True) for _ in range(2))
+        out.update({
+            "epoch_replay_sigs_serial_blocks_per_s":
+                round(64 / sig_ser, 1),
+            "epoch_replay_sigs_blocks_per_s": round(64 / sig_bat, 1),
+            "epoch_replay_sigs_speedup": round(sig_ser / sig_bat, 2),
+        })
+    finally:
+        B.set_backend(prev_backend)
+    return out
+
+
 def _probe_backend(timeout_s: float) -> str | None:
     """Fail-fast device probe (round-5 VERDICT): `jax.devices()` through a
     dead axon tunnel can block until the per-row watchdog hard-exits the
@@ -1548,6 +1689,7 @@ _ROWS = [
     ("stream", _stream_verify_bench, "stream_verify", False),
     ("sustained", _sustained_slo_bench, "sustained_slo", False),
     ("restart", _restart_recovery_bench, "restart_recovery", False),
+    ("replay", _epoch_replay_bench, "epoch_replay_blocks_per_s", False),
     ("lc_bootstrap", _lc_bootstrap_bench, "light_client_bootstrap",
      False),
     ("proof", _proof_engine_bench, "proof_extract_batch", True),

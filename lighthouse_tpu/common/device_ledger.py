@@ -89,6 +89,7 @@ SUBSYSTEMS: Tuple[str, ...] = (
     "staging",          # ChunkStager / cold-build streaming pushes
     "proof_engine",     # device Merkle-branch extraction / proof serving
     "op_pool",          # block-packing CSR columns + greedy-pack rounds
+    "replay",           # epoch-batched replay windows (catch-up sync)
 )
 
 # Compile events that fire outside any attribution seam (conftest
@@ -151,6 +152,22 @@ WARM_SLOT_BUDGET: Dict[str, Dict[str, int]] = {
     # backlogged mainnet pool is a few M entries) go up once per
     # produce; the selection vector coming down is rounds × 4 B.
     "op_pool": {"h2d_bytes": 256 * MiB, "d2h_bytes": 1 * MiB},
+    # Catch-up replay belongs OUTSIDE warm slots: a node that is in
+    # sync imports via the live pipeline (whose signature traffic is
+    # the bls family).  Replay-attributed transfers inside a warm slot
+    # mean a backfill/range-sync window leaked onto the hot path.
+    "replay": {"h2d_bytes": 0, "d2h_bytes": 0},
+}
+
+# Per-WINDOW transfer budget for one epoch-batched replay window
+# (state_transition/batch_replay.py): the window's signature sets
+# marshalled up in one sharded dispatch (~50 KB per 16-key set; a
+# 128-block window of full mainnet blocks is ≲ 2k sets), verdict flags
+# down.  Evaluated per window by the replayer itself — replay runs at
+# catch-up time, not per slot, so the warm-slot ring is the wrong
+# denominator.
+REPLAY_WINDOW_BUDGET: Dict[str, int] = {
+    "h2d_bytes": 256 * MiB, "d2h_bytes": 1 * MiB,
 }
 
 
@@ -204,7 +221,11 @@ class DeviceLedger:
         from .knobs import knob_bool, knob_int
         self.enabled = knob_bool("LIGHTHOUSE_TPU_DEVICE_LEDGER")
         self.max_slots = knob_int("LIGHTHOUSE_TPU_DEVICE_LEDGER_SLOTS")
-        self._lock = threading.Lock()
+        # Reentrant: ResidencyToken.release runs as a weakref.finalize
+        # GC callback, and a collection can trigger inside any locked
+        # section of the SAME thread (an allocation under the lock) —
+        # release -> _adjust_resident must then re-enter, not deadlock.
+        self._lock = threading.RLock()
         self._tls = threading.local()
         self._sub: Dict[str, Dict[str, float]] = {
             s: dict.fromkeys(_COUNTER_KEYS, 0) for s in SUBSYSTEMS

@@ -117,6 +117,11 @@ _declare("LIGHTHOUSE_TPU_EPOCH_DEVICE", "bool", False,
 _declare("LIGHTHOUSE_TPU_DEVICE_STATE", "bool", True,
          "Device-resident BeaconState: HBM is the hashing source of "
          "truth (0 = host incremental oracle).")
+_declare("LIGHTHOUSE_TPU_BATCH_REPLAY", "tribool", "auto",
+         "Epoch-batched replay for range sync / recovery / backfill: "
+         "one window-wide signature batch, known state roots, one "
+         "boundary root (auto: batch windows of >= 4 blocks; 0 = "
+         "serial BlockReplayer oracle).")
 
 # -- block production / op pool --
 _declare("LIGHTHOUSE_TPU_DEVICE_PACK", "bool", True,

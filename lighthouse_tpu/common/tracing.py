@@ -577,6 +577,11 @@ def _src_op_pool() -> dict:
     return LAST_PACK_STATS
 
 
+def _src_replay() -> dict:
+    from ..state_transition.batch_replay import LAST_REPLAY_TIMINGS
+    return LAST_REPLAY_TIMINGS
+
+
 _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "block": _src_block,
     "epoch": _src_epoch,
@@ -591,6 +596,7 @@ _STAGE_SOURCES: Dict[str, Callable[[], dict]] = {
     "block_sigs": _src_block_sigs,
     "device_ledger": _src_device_ledger,
     "op_pool": _src_op_pool,
+    "replay": _src_replay,
 }
 
 

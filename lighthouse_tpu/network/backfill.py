@@ -43,10 +43,24 @@ class BackfillSync:
             # Genesis boot: nothing to backfill.
             self.progress = BackfillProgress(0, b"\x00" * 32, complete=True)
         else:
+            # RESUME: an interrupted backfill committed whole batches
+            # atomically below the anchor — walk the stored parent chain
+            # down to the oldest contiguous block so a restart requests
+            # nothing it already holds (the crash-drill "no re-import"
+            # invariant; `backfill_sync/mod.rs` resumes from
+            # oldest_block_parent the same way).
+            oldest = anchor
+            exp = bytes(anchor.message.parent_root)
+            while exp != b"\x00" * 32:
+                b = chain.store.get_block(exp)
+                if b is None:
+                    break
+                oldest = b
+                exp = bytes(b.message.parent_root)
+            slot = int(oldest.message.slot)
             self.progress = BackfillProgress(
-                oldest_slot=int(anchor.message.slot),
-                expected_root=bytes(anchor.message.parent_root),
-                complete=int(anchor.message.slot) == 0)
+                oldest_slot=slot, expected_root=exp,
+                complete=slot == 0)
 
     def fill_from(self, peer) -> bool:
         """One batch from ``peer``; returns True if progress was made.
@@ -103,11 +117,28 @@ class BackfillSync:
                 signing_keys=[chain.pubkey_cache.get(state.validators,
                                                      proposer)],
                 message=compute_signing_root(root, domain)))
-        if sets and not bls.verify_signature_sets(sets):
-            raise BackfillError("backfill batch signature verification "
-                                "failed")
+        if sets:
+            # One dispatcher-routed batch: dedup + the mesh-sharded BLS
+            # path on a device backend — the same route the batched
+            # replay windows take.
+            from ..state_transition.sig_dispatch import get_dispatcher
+            try:
+                ok = get_dispatcher().submit(
+                    sets, slot=int(blocks[-1].message.slot)).join()
+            except Exception as e:
+                raise BackfillError(
+                    f"backfill batch signature verification errored: "
+                    f"{e}") from e
+            if not ok:
+                raise BackfillError("backfill batch signature "
+                                    "verification failed")
+        # ONE atomic commit per batch: a crash mid-batch leaves either
+        # the whole batch or none of it, so the resume walk in
+        # ``__init__`` always lands on a batch boundary.
+        ops: List[tuple] = []
         for b, root in zip(reversed(blocks), roots):
-            chain.store.put_block(root, b)
+            ops.extend(chain.store.block_put_ops(root, b))
+        chain.store.do_atomically(ops)
         oldest = int(blocks[0].message.slot)
         self.progress = BackfillProgress(
             oldest_slot=oldest, expected_root=exp,

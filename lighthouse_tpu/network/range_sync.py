@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Dict, List, Optional, Tuple
 
+from ..sync import Outcome
 from .peer_manager import PeerAction
 from .service import BlocksByRangeRequest
 
@@ -153,10 +154,21 @@ class SyncingChain:
                 break
             b.state = BatchState.PROCESSING
             served_by = b.attempts[-1]
-            ok = self._process(node, b)
-            if ok:
+            out = self._process(node, b)
+            if out is Outcome.OK:
                 b.state = BatchState.PROCESSED
                 peer_manager.report(served_by, PeerAction.SYNC_SERVED)
+                progressed = True
+            elif out is Outcome.FATAL:
+                # Deterministic BAD BLOCK: every honest peer would serve
+                # the same bytes, so rotating peers only burns
+                # MAX_BATCH_ATTEMPTS on the same verdict — fail the
+                # chain NOW (`chain.rs` on_batch_process_result
+                # FaultyFailure w/ penalize, but a consensus-invalid
+                # block removes the chain).
+                peer_manager.report(served_by, PeerAction.INVALID_MESSAGE)
+                b.blocks = []
+                b.state = BatchState.FAILED
                 progressed = True
             else:
                 # bad batch: penalize the server, retry on another peer
@@ -167,33 +179,25 @@ class SyncingChain:
             break
         return progressed
 
-    def _process(self, node, batch: BatchInfo) -> bool:
-        """Import the batch as a chain segment.  An EMPTY batch is valid
-        (skipped slots); corrupt/unimportable blocks fail the batch.
+    def _process(self, node, batch: BatchInfo):
+        """Import the batch as a chain segment through the shared seam
+        (``lighthouse_tpu.sync.process_chain_segment``: epoch-batched
+        replay when the knob/window allow, serial oracle otherwise).  An
+        EMPTY batch is valid (skipped slots).
 
-        Deneb: a blob-carrying block raises BlobsUnavailable on first
+        Deneb: a blob-carrying block surfaces ``needs_blobs`` on first
         import — fetch its sidecars by root (the range-sync blob flow)
         and retry once; only a still-unavailable block fails the batch
         (its server withheld data it advertised)."""
-        from ..beacon_chain import (
-            BlobsUnavailable, BlockError, BlockIsAlreadyKnown)
+        from ..sync import process_chain_segment
 
-        for b in batch.blocks:
-            try:
-                node.chain.per_slot_task(int(b.message.slot))
-                try:
-                    node.chain.process_block(b)
-                except BlobsUnavailable:
-                    if not node._fetch_blobs(b):
-                        return False
-                    node.chain.process_block(b)
-            except BlockIsAlreadyKnown:
-                continue
-            except BlockError:
-                return False
-            except Exception:
-                return False
-        return True
+        res = process_chain_segment(node.chain, batch.blocks)
+        if res.needs_blobs is not None:
+            if node._fetch_blobs(res.needs_blobs):
+                res = process_chain_segment(node.chain, batch.blocks)
+            if res.needs_blobs is not None:
+                return Outcome.RETRY
+        return res.outcome
 
 
 class RangeSync:

@@ -500,22 +500,19 @@ class NetworkNode:
                 chain_segment.append(parent)
                 want = bytes(parent.message.parent_root)
             if self.chain.fork_choice.contains_block(want) and chain_segment:
-                ok = False
-                for b in reversed(chain_segment):
-                    try:
-                        self.chain.per_slot_task(int(b.message.slot))
-                        try:
-                            self.chain.process_block(b)
-                        except BlobsUnavailable:
-                            # The recovered segment's block carries blobs
-                            # we never saw on gossip: fetch by root (the
-                            # same peers that served the blocks), retry.
-                            if not self._fetch_blobs(b):
-                                raise
-                            self.chain.process_block(b)
-                        ok = True
-                    except BlockError:
-                        pass
+                # Oldest-first import through the shared segment seam
+                # (epoch-batched replay when the window allows, serial
+                # oracle otherwise — same path as range sync).
+                from ..sync import Outcome, process_chain_segment
+                segment = list(reversed(chain_segment))
+                res = process_chain_segment(self.chain, segment)
+                if res.needs_blobs is not None:
+                    # The recovered segment carries blobs we never saw
+                    # on gossip: fetch by root (the same peers that
+                    # served the blocks), retry once.
+                    if self._fetch_blobs(res.needs_blobs):
+                        res = process_chain_segment(self.chain, segment)
+                ok = res.outcome is Outcome.OK or res.imported > 0
                 if ok:
                     self.peer_manager.report(peer, PeerAction.SYNC_SERVED)
                     return True

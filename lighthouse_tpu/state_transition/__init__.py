@@ -19,6 +19,16 @@ from .per_block import (
     SignatureStrategy,
     process_block,
 )
+from .batch_replay import (
+    EpochReplayer,
+    WindowBlockInvalid,
+    WindowError,
+    WindowRootMismatch,
+    WindowSignaturesInvalid,
+    batch_replay_enabled,
+    known_roots_fn,
+    replay_states,
+)
 from .per_epoch import process_epoch
 from .per_slot import (
     SlotProcessingError,
@@ -41,4 +51,7 @@ __all__ = [
     "interop_secret_key", "compute_domain", "compute_epoch_at_slot",
     "compute_signing_root", "compute_start_slot_at_epoch", "current_epoch",
     "get_active_validator_indices",
+    "EpochReplayer", "WindowBlockInvalid", "WindowError",
+    "WindowRootMismatch", "WindowSignaturesInvalid",
+    "batch_replay_enabled", "known_roots_fn", "replay_states",
 ]

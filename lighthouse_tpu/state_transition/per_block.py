@@ -86,11 +86,19 @@ def _phase(name: str, t0: float) -> float:
 
 
 class SignatureStrategy(enum.Enum):
-    """``BlockSignatureStrategy`` (``per_block_processing.rs:49-58``)."""
+    """``BlockSignatureStrategy`` (``per_block_processing.rs:49-58``).
+
+    ``BATCH_DEFERRED`` extends the reference set for the epoch-batched
+    replay engine (:mod:`.batch_replay`): every set is accumulated like
+    ``VERIFY_BULK`` but the accumulator never verifies or dispatches on
+    its own — the WINDOW owner harvests ``acc.sets`` across many blocks
+    and delivers one sharded verdict that gates commit of the whole
+    window."""
     NO_VERIFICATION = "no_verification"
     VERIFY_INDIVIDUAL = "verify_individual"
     VERIFY_BULK = "verify_bulk"
     VERIFY_RANDAO = "verify_randao"
+    BATCH_DEFERRED = "batch_deferred"
 
 
 class SigAccumulator:
@@ -213,7 +221,8 @@ def process_block(state, signed_block, fork: ForkName, preset, spec, T,
     LAST_BLOCK_TIMINGS.clear()
     t0 = time.perf_counter()
     if strategy in (SignatureStrategy.VERIFY_INDIVIDUAL,
-                    SignatureStrategy.VERIFY_BULK):
+                    SignatureStrategy.VERIFY_BULK,
+                    SignatureStrategy.BATCH_DEFERRED):
         acc.add(sigs.block_proposal_signature_set(
             state, signed_block, pubkey_cache, preset,
             block_root=verify_block_root))

@@ -34,6 +34,7 @@ from typing import Iterable, List, Optional, Tuple
 
 from ..common import tracing
 from ..types.chain_spec import ForkName
+from ..state_transition.batch_replay import known_roots_fn
 from ..state_transition.block_replayer import BlockReplayer
 from .kv import (
     ChecksumError,
@@ -382,7 +383,12 @@ class HotColdDB:
             raise StoreError("missing epoch boundary state for summary")
         blocks = self._block_chain_to(summary.latest_block_root,
                                       int(base.slot))
-        replayer = BlockReplayer(base, self.preset, self.spec, self.T)
+        # Known roots: the stored chain's blocks already carry their
+        # (import-verified) post-state roots, so the replay skips every
+        # per-slot tree hash except at empty slots past the last block
+        # (`block_replayer.rs` state_root_iter).
+        replayer = BlockReplayer(base, self.preset, self.spec, self.T,
+                                 state_root_fn=known_roots_fn(blocks))
         return replayer.apply_blocks(blocks, target_slot=summary.slot)
 
     # -- finalization migration (hot → cold) ---------------------------------

@@ -162,6 +162,83 @@ def _pending_blocks(store: HotColdDB, known: set,
                   key=lambda t: (t[0], t[1]))
 
 
+def _segment_replay_cache(store: HotColdDB, chain,
+                          pending: List[Tuple[int, bytes]]) -> dict:
+    """Batched rebuild acceleration: group the pending blocks into
+    parent-linked segments and prime ``{block_root: post_state}`` for
+    each multi-block segment with ONE :func:`replay_states` window from
+    the segment's base state, instead of one ``store.get_state`` —
+    potentially an O(epoch) summary replay EACH — per block.
+
+    Purely a cache: the reconcile loop's orphan decisions still key off
+    the store's own rows (a computed state never resurrects a partial
+    import whose state row is gone), and any segment whose base state
+    won't load simply falls back to the per-block path."""
+    from ..state_transition.batch_replay import (batch_replay_enabled,
+                                                 replay_states)
+    if not pending or not batch_replay_enabled(len(pending)):
+        return {}
+    blocks: dict[bytes, object] = {}
+    for _slot, root in pending:
+        b = store.get_block(root)
+        if b is not None:
+            blocks[root] = b
+    # Greedy parent-linking in slot order; a fork's second child starts
+    # its own segment (its base state comes from the cache when the
+    # sibling's segment already computed it).
+    segments: List[Tuple[bytes, List[Tuple[bytes, object]]]] = []
+    tips: dict[bytes, tuple] = {}
+    for _slot, root in pending:
+        b = blocks.get(root)
+        if b is None:
+            continue
+        parent = bytes(b.message.parent_root)
+        seg = tips.pop(parent, None)
+        if seg is None:
+            seg = (parent, [])
+            segments.append(seg)
+        seg[1].append((root, b))
+        tips[root] = seg
+    cache: dict = {}
+    for base_root, pairs in segments:
+        if len(pairs) < 2:
+            continue
+        base_state = cache.get(base_root)
+        if base_state is None:
+            try:
+                if bytes(base_root) == bytes(chain.genesis_block_root):
+                    base_state = store.get_state(
+                        bytes(chain.genesis_state_root))
+                else:
+                    base_block = store.get_block(base_root)
+                    if base_block is None:
+                        continue
+                    base_state = store.get_state(
+                        bytes(base_block.message.state_root))
+            except (StoreCorruption, StoreError):
+                base_state = None
+        if base_state is None:
+            continue
+        try:
+            cache.update(replay_states(base_state, pairs, store.preset,
+                                       store.spec, store.T))
+        except Exception:
+            # Segment won't replay (e.g. a slot gap the stored chain
+            # can't bridge) — the per-block loop handles its blocks.
+            continue
+    return cache
+
+
+def _state_row_present(store: HotColdDB, state_root: bytes) -> bool:
+    """Does the store hold ANY row for this state root (full, cold or
+    summary)?  The exact set :meth:`HotColdDB.get_state` consults — the
+    orphan rule stays keyed to store contents even when a replay cache
+    can synthesize the state."""
+    return any(store._get_value(col, state_root) is not None
+               for col in (DBColumn.BeaconState, DBColumn.ColdState,
+                           DBColumn.BeaconStateSummary))
+
+
 def reconcile(store: HotColdDB, chain, report: RecoveryReport,
               *, genesis_root: bytes) -> RecoveryReport:
     """Stages 2-4 against a constructed chain (its ``fork_choice`` is
@@ -196,8 +273,16 @@ def reconcile(store: HotColdDB, chain, report: RecoveryReport,
     # imports as they surface.
     known = set(bytes(r) for r in fc.proto.indices)
     orphan_ops: List[tuple] = []
-    for slot, root in _pending_blocks(store, known,
-                                      report.rebuilt_fork_choice):
+    pending = _pending_blocks(store, known, report.rebuilt_fork_choice)
+    # Cold-then-hot rebuild at device rate: prime the per-block states
+    # with one batched window per parent-linked segment (the per-block
+    # ``get_state`` below degenerates to an O(epoch) summary replay per
+    # non-boundary block).
+    replay_cache = _segment_replay_cache(store, chain, pending)
+    if replay_cache:
+        report.notes.append(
+            f"batched replay primed {len(replay_cache)} rebuild states")
+    for slot, root in pending:
         block = store.get_block(root)
         if block is None:
             # Journal entry whose block row was quarantined.
@@ -213,10 +298,15 @@ def reconcile(store: HotColdDB, chain, report: RecoveryReport,
                 store, root, bytes(block.message.state_root))
             report.orphans_removed.append(root)
             continue
-        try:
-            state = store.get_state(bytes(block.message.state_root))
-        except (StoreCorruption, StoreError):
-            state = None
+        state = None
+        if root in replay_cache and \
+                _state_row_present(store, bytes(block.message.state_root)):
+            state = replay_cache[root]
+        if state is None:
+            try:
+                state = store.get_state(bytes(block.message.state_root))
+            except (StoreCorruption, StoreError):
+                state = None
         if state is None:
             orphan_ops += _orphan_ops(
                 store, root, bytes(block.message.state_root))

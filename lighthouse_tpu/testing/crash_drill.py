@@ -264,6 +264,198 @@ def run_kill_point(fixture: ChainFixture, backend, kill_at: int,
     return chain2, crashed, chain2.last_recovery
 
 
+# -- checkpoint-sync backfill drill -------------------------------------------
+
+
+@dataclass
+class BackfillFixture:
+    """A checkpoint-sync scenario: a trusted anchor block + its
+    post-state partway up a deterministic chain, with the FULL history
+    available from a stub peer.  The drill boots from the anchor,
+    backfills toward genesis, and SIGKILLs mid-batch."""
+    preset: object
+    spec: object
+    T: object
+    anchor_slot: int
+    anchor_root: bytes
+    anchor_block: object
+    anchor_state: object
+    blocks: List[Tuple[int, bytes, object]]  # (slot, root, signed_block)
+
+
+def build_backfill_fixture(slots: int = 24, n_validators: int = 16,
+                           preset=None,
+                           anchor_slot: Optional[int] = None
+                           ) -> BackfillFixture:
+    from ..types.presets import MINIMAL
+    from .harness import StateHarness
+
+    h = StateHarness(n_validators=n_validators, preset=preset or MINIMAL)
+    blocks: List[Tuple[int, bytes, object]] = []
+    anchor_state = None
+    for _ in range(slots):
+        sb = h.build_block()
+        h.apply_block(sb)
+        blocks.append((int(sb.message.slot),
+                       sb.message.tree_hash_root(), sb))
+        if anchor_slot is not None and int(sb.message.slot) == anchor_slot:
+            anchor_state = h.state.copy()
+    if anchor_slot is None:
+        anchor_slot = blocks[-1][0]
+        anchor_state = h.state.copy()
+    if anchor_state is None:
+        raise ValueError(f"no block at anchor slot {anchor_slot}")
+    anchor = next((b for b in blocks if b[0] == anchor_slot))
+    return BackfillFixture(preset=h.preset, spec=h.spec, T=h.T,
+                           anchor_slot=anchor_slot,
+                           anchor_root=bytes(anchor[1]),
+                           anchor_block=anchor[2],
+                           anchor_state=anchor_state, blocks=blocks)
+
+
+class HistoryPeer:
+    """Stub peer serving the fixture's full history; records every
+    range it was asked for (the "no re-import" invariant reads it)."""
+
+    def __init__(self, fixture: BackfillFixture):
+        self._blocks = fixture.blocks
+        self.requests: List[Tuple[int, int]] = []
+
+    def blocks_by_range(self, req):
+        self.requests.append((int(req.start_slot), int(req.count)))
+        return [sb for slot, _root, sb in self._blocks
+                if req.start_slot <= slot < req.start_slot + req.count]
+
+
+def _boot_checkpoint(store: HotColdDB, fixture: BackfillFixture):
+    return BeaconChain.from_checkpoint(
+        store=store, anchor_state=fixture.anchor_state.copy(),
+        anchor_block=fixture.anchor_block, preset=fixture.preset,
+        spec=fixture.spec, T=fixture.T)
+
+
+def _run_backfill(chain, fixture: BackfillFixture,
+                  batch_size: int = 8) -> None:
+    from ..network.backfill import BackfillSync
+    bf = BackfillSync(chain, batch_size=batch_size)
+    peer = HistoryPeer(fixture)
+    while not bf.progress.complete:
+        if not bf.fill_from(peer):
+            break
+
+
+def count_backfill_ops(fixture: BackfillFixture, backend,
+                       batch_size: int = 8) -> int:
+    """Mutations of a clean checkpoint-boot + full backfill, counted
+    from after the boot (the drill's kill-point universe).  The small
+    default ``batch_size`` forces SEVERAL atomic batches out of a
+    modest fixture, so the drill has mid-backfill kill points."""
+    inj = FaultInjector(seed=0)
+    kv = CrashingStore(backend.fresh(), inj)
+    store = HotColdDB(kv, fixture.preset, fixture.spec, fixture.T)
+    chain = _boot_checkpoint(store, fixture)
+    before = kv.mutations
+    _run_backfill(chain, fixture, batch_size=batch_size)
+    return kv.mutations - before
+
+
+def run_backfill_kill_point(fixture: BackfillFixture, backend,
+                            kill_at: int, *, seed: int = 0,
+                            batch_size: int = 8) -> List[str]:
+    """One run: checkpoint boot, backfill, die after store op
+    ``kill_at``, restart, recover, RESUME backfill.  Returns the list
+    of violated invariants (empty == green):
+
+    - recovery must not orphan any committed backfill block (they sit
+      below the anchor with parents outside fork choice — the
+      historical-floor rule classifies them ``skipped_stale``);
+    - the resumed backfill must start exactly at the oldest committed
+      block (atomic per-batch commits → no torn batch) and never
+      re-request a slot range it already holds;
+    - the finished history must be complete down to genesis.
+    """
+    from ..network.backfill import BackfillSync
+
+    inj = FaultInjector(seed=seed)
+    inner = backend.fresh()
+    crashing = CrashingStore(inner, inj)
+    store = HotColdDB(crashing, fixture.preset, fixture.spec, fixture.T)
+    chain = _boot_checkpoint(store, fixture)
+    armed_at = crashing.mutations
+    inj.plan(CrashingStore.SITE, outage=(armed_at + kill_at, _FOREVER))
+    try:
+        _run_backfill(chain, fixture, batch_size=batch_size)
+    except InjectedFault:
+        pass
+    # "Restart": a brand-new process sees only the surviving bytes.
+    kv2 = backend.reopen(inner)
+    store2 = HotColdDB(kv2, fixture.preset, fixture.spec, fixture.T)
+    chain2 = BeaconChain.from_store(store=store2, preset=fixture.preset,
+                                    spec=fixture.spec, T=fixture.T)
+    failures: List[str] = []
+    report = chain2.last_recovery
+    if report is not None and report.orphans_removed:
+        failures.append(
+            f"recovery orphaned {len(report.orphans_removed)} committed "
+            f"backfill blocks (historical-floor rule violated)")
+    # Oldest committed block BELOW the anchor, by direct store probe.
+    committed = [slot for slot, root, _sb in fixture.blocks
+                 if slot < fixture.anchor_slot
+                 and store2.get_block(bytes(root)) is not None]
+    oldest_committed = min(committed) if committed else fixture.anchor_slot
+    bf2 = BackfillSync(chain2, batch_size=batch_size)
+    if bf2.progress.oldest_slot != oldest_committed:
+        failures.append(
+            f"resume point {bf2.progress.oldest_slot} != oldest committed "
+            f"slot {oldest_committed} (would re-download history)")
+    peer2 = HistoryPeer(fixture)
+    while not bf2.progress.complete:
+        if not bf2.fill_from(peer2):
+            break
+    for start, count in peer2.requests:
+        if start + count > oldest_committed:
+            failures.append(
+                f"resumed backfill re-requested [{start}, {start + count})"
+                f" overlapping committed history >= {oldest_committed}")
+            break
+    if not bf2.progress.complete:
+        failures.append("resumed backfill did not complete")
+    missing = [slot for slot, root, _sb in fixture.blocks
+               if slot < fixture.anchor_slot
+               and store2.get_block(bytes(root)) is None]
+    if missing:
+        failures.append(f"history incomplete after resume: missing "
+                        f"slots {missing[:5]}")
+    return failures
+
+
+def backfill_kill_point_drill(fixture: BackfillFixture, backend,
+                              kill_points: Optional[List[int]] = None,
+                              *, seed: int = 0, batch_size: int = 8,
+                              on_progress: Optional[Callable] = None
+                              ) -> dict:
+    """Kill the backfill at every requested store op (``None`` =
+    exhaustive); ``report["failures"]`` empty == green."""
+    total_ops = count_backfill_ops(fixture, backend, batch_size=batch_size)
+    if kill_points is None:
+        kill_points = list(range(total_ops))
+    failures = []
+    for n in kill_points:
+        bad = run_backfill_kill_point(fixture, backend, n, seed=seed,
+                                      batch_size=batch_size)
+        if bad:
+            failures.append({"kill_at": n, "violations": bad})
+        if on_progress is not None:
+            on_progress(n, len(kill_points), bool(bad))
+    return {
+        "backend": backend.name,
+        "anchor_slot": fixture.anchor_slot,
+        "total_ops": total_ops,
+        "kill_points": len(kill_points),
+        "failures": failures,
+    }
+
+
 def kill_point_drill(fixture: ChainFixture, backend,
                      kill_points: Optional[List[int]] = None,
                      *, seed: int = 0,

@@ -151,6 +151,34 @@ class Simulator:
         self.nodes[i] = node
         return node
 
+    # -- partition / heal ----------------------------------------------------
+
+    def partition_node(self, i: int) -> None:
+        """NETWORK partition (vs :meth:`crash_node`'s process death):
+        the node's sockets and discovery drop but its chain, store and
+        validator keys stay alive in-process — the classic
+        partition → heal → range-sync convergence race.  The clean
+        ``persist=True`` close keeps the fork-choice snapshot coherent;
+        the store handle stays OPEN (the process never died)."""
+        node = self.nodes[i]
+        node.discovery.close()
+        node.net.close(persist=True)
+        self._down[i] = {"cfg": self._node_cfg[i], "chain": node.chain,
+                         "partitioned": True}
+        self.nodes[i] = None  # type: ignore[assignment]
+
+    def heal_node(self, i: int) -> SimNode:
+        """Re-wire a partitioned node around its LIVE chain: fresh
+        sockets + discovery, same state.  The healed node is behind the
+        mesh by however many slots the partition lasted; range sync
+        (epoch-batched replay underneath) closes the gap."""
+        down = self._down.get(i)
+        assert down and down.get("partitioned"), "node was not partitioned"
+        self._down.pop(i)
+        node = self._start_node(i, down["chain"])
+        self.nodes[i] = node
+        return node
+
     @property
     def live_nodes(self) -> List[SimNode]:
         return [n for n in self.nodes if n is not None]

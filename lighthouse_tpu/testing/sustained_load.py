@@ -164,6 +164,7 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
         # transitions must not pollute the node's slot buckets).
         TRACER.disable()
         h = StateHarness(n_validators=n_validators, preset=MINIMAL)
+        genesis_for_catchup = h.state.copy()
         hdr = h.state.latest_block_header.copy()
         hdr.state_root = h.state.tree_hash_root()
         chain = BeaconChain(
@@ -499,6 +500,36 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
         zero_loss = (not missing and st["rejected"] == 0
                      and st["shed"] == 0
                      and st["verified"] == st["submitted"])
+        # Catch-up lane (batched-replay PR): after the measured run,
+        # replay the drill's whole block history onto a fresh genesis
+        # copy through the EpochReplayer — the rate a node that missed
+        # the run would close the gap at, in the drill's own shape.
+        # The per-window decomposition comes through the ONE stage
+        # adapter (tracing.stage_split — never the raw timings dict);
+        # the cross-shape reference number is bench.py's
+        # ``epoch_replay_blocks_per_s`` row.
+        catch_up: dict = {"blocks": len(h.blocks)}
+        if h.blocks:
+            from ..common.tracing import stage_split
+            from ..state_transition import EpochReplayer
+            try:
+                rep = EpochReplayer(genesis_for_catchup.copy(),
+                                    h.preset, h.spec, h.T,
+                                    verify_signatures=False)
+                t0 = time.perf_counter()
+                spe = h.preset.SLOTS_PER_EPOCH
+                for i in range(0, len(h.blocks), spe):
+                    rep.apply_window(h.blocks[i:i + spe])
+                catch_s = time.perf_counter() - t0
+                catch_up.update({
+                    "blocks_per_s": round(len(h.blocks) / catch_s, 1)
+                    if catch_s > 0 else None,
+                    "wall_s": round(catch_s, 3),
+                    "stage": stage_split("replay"),
+                    "bench_row": "epoch_replay_blocks_per_s",
+                })
+            except Exception as e:  # noqa: BLE001 — scoreboard signal
+                catch_up["error"] = f"{type(e).__name__}: {e}"
         scoreboard = {
             "config": {
                 "slots": slots, "slot_s": slot_s,
@@ -559,6 +590,7 @@ def run_sustained(*, slots: int = 24, slot_s: float = 0.5,
                 "adopted": chain._produce_adopted,
                 "serial": chain._produce_serial,
             },
+            "catch_up": catch_up,
             "host_fallbacks": st["bls"]["host_fallbacks"],
             "breaker": st["bls"]["breaker"],
             "per_slot": per_slot,

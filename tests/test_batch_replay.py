@@ -1,0 +1,363 @@
+"""Epoch-batched replay differentials: the EpochReplayer against the
+serial BlockReplayer oracle.
+
+The batched engine must be BIT-IDENTICAL to the serial path on honest
+windows (randomized splits across epoch boundaries and skipped slots),
+must NAME the exact offending block when a window lies (tampered
+signature → bisect; tampered claimed state root → serial fallback), and
+must collapse to the oracle when the ``LIGHTHOUSE_TPU_BATCH_REPLAY``
+knob forces it off.  Rides along: the range-sync regression for
+deterministic block errors (fail the chain NOW, don't burn peer
+retries) and the backfill kill-point drill on both store backends.
+"""
+
+import os
+import random
+from contextlib import contextmanager
+
+import pytest
+
+from lighthouse_tpu.crypto import bls as B
+from lighthouse_tpu.state_transition import (
+    EpochReplayer,
+    WindowRootMismatch,
+    WindowSignaturesInvalid,
+    batch_replay_enabled,
+    replay_states,
+)
+from lighthouse_tpu.state_transition.block_replayer import BlockReplayer
+from lighthouse_tpu.state_transition.per_block import SignatureStrategy
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.presets import MINIMAL
+
+
+@contextmanager
+def replay_knob(value):
+    prev = os.environ.pop("LIGHTHOUSE_TPU_BATCH_REPLAY", None)
+    if value is not None:
+        os.environ["LIGHTHOUSE_TPU_BATCH_REPLAY"] = value
+    try:
+        yield
+    finally:
+        os.environ.pop("LIGHTHOUSE_TPU_BATCH_REPLAY", None)
+        if prev is not None:
+            os.environ["LIGHTHOUSE_TPU_BATCH_REPLAY"] = prev
+
+
+@pytest.fixture()
+def fakebls():
+    prev = next(k for k, v in B._BACKENDS.items() if v is B.get_backend())
+    B.set_backend("fake")
+    yield
+    B.set_backend(prev)
+
+
+@pytest.fixture()
+def pybls():
+    prev = next(k for k, v in B._BACKENDS.items() if v is B.get_backend())
+    B.set_backend("python")
+    yield
+    B.set_backend(prev)
+
+
+# -- shared fixtures (built once; tests replay copies) ------------------------
+
+# Fake-signed chain with skipped slots crossing MINIMAL epoch boundaries
+# (8-slot epochs; gaps at 5→7, 11→14, 17→20).
+_FAKE: dict = {}
+# Real-signed short chain for the signature-batch tests (python backend
+# signing is the expensive part — build once).
+_REAL: dict = {}
+
+_GAPPY_SLOTS = [1, 2, 3, 4, 5, 7, 8, 9, 10, 11, 14, 15, 16, 17, 20, 21,
+                22, 23, 24, 25]
+
+
+def _fake_fixture() -> dict:
+    if not _FAKE:
+        prev = next(k for k, v in B._BACKENDS.items()
+                    if v is B.get_backend())
+        B.set_backend("fake")
+        try:
+            h = StateHarness(n_validators=16, preset=MINIMAL)
+            genesis = h.state.copy()
+            for slot in _GAPPY_SLOTS:
+                h.apply_block(h.build_block(slot=slot),
+                              strategy=SignatureStrategy.NO_VERIFICATION)
+            _FAKE.update(h=h, genesis=genesis, blocks=list(h.blocks))
+        finally:
+            B.set_backend(prev)
+    return _FAKE
+
+
+def _real_fixture() -> dict:
+    if not _REAL:
+        prev = next(k for k, v in B._BACKENDS.items()
+                    if v is B.get_backend())
+        B.set_backend("python")
+        try:
+            h = StateHarness(n_validators=16, preset=MINIMAL)
+            genesis = h.state.copy()
+            h.extend_chain(6)
+            _REAL.update(h=h, genesis=genesis, blocks=list(h.blocks))
+        finally:
+            B.set_backend(prev)
+    return _REAL
+
+
+def _serial_root(genesis, blocks, h) -> bytes:
+    """The oracle: one block at a time, FULL per-slot hashing."""
+    rep = BlockReplayer(genesis.copy(), h.preset, h.spec, h.T,
+                        strategy=SignatureStrategy.NO_VERIFICATION)
+    rep.apply_blocks(blocks)
+    return bytes(rep.state.tree_hash_root())
+
+
+# -- randomized differentials -------------------------------------------------
+
+@pytest.mark.timeout(240)
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_batched_windows_bit_identical_to_serial_oracle(fakebls, seed):
+    """Random window splits (mid-epoch boundaries, skipped slots
+    included) replayed through EpochReplayer land on the EXACT final
+    state root the serial oracle computes."""
+    fx = _fake_fixture()
+    h, blocks = fx["h"], fx["blocks"]
+    oracle = _serial_root(fx["genesis"], blocks, h)
+
+    rng = random.Random(seed)
+    rep = EpochReplayer(fx["genesis"].copy(), h.preset, h.spec, h.T,
+                        verify_signatures=False)
+    i = 0
+    windows = 0
+    while i < len(blocks):
+        n = rng.randint(1, 9)
+        rep.apply_window(blocks[i:i + n])
+        i += n
+        windows += 1
+    assert windows > 1, "splits must exercise multiple windows"
+    assert bytes(rep.state.tree_hash_root()) == oracle
+
+
+@pytest.mark.timeout(240)
+def test_replay_states_primes_every_post_state(fakebls):
+    """The recovery-rebuild entry point returns per-block post states
+    matching each block's claimed (import-verified) state root."""
+    fx = _fake_fixture()
+    h, blocks = fx["h"], fx["blocks"]
+    pairs = [(bytes(b.message.tree_hash_root()), b) for b in blocks[:8]]
+    out = replay_states(fx["genesis"], pairs, h.preset, h.spec, h.T)
+    assert len(out) == 8
+    for (root, b) in pairs:
+        assert bytes(out[root].tree_hash_root()) == \
+            bytes(b.message.state_root)
+
+
+# -- failure bisects ----------------------------------------------------------
+
+@pytest.mark.timeout(240)
+def test_tampered_signature_window_names_exact_block(pybls):
+    """A window whose batch verdict fails is bisected to the exact
+    offending block — not just rejected wholesale."""
+    fx = _real_fixture()
+    h = fx["h"]
+    blocks = [b.copy() for b in fx["blocks"]]
+    # Valid BLS point, wrong message: another block's proposal signature.
+    blocks[3].signature = fx["blocks"][2].signature
+    rep = EpochReplayer(fx["genesis"].copy(), h.preset, h.spec, h.T,
+                        verify_signatures=True)
+    with pytest.raises(WindowSignaturesInvalid) as ei:
+        rep.apply_window(blocks)
+    assert ei.value.slot == int(blocks[3].message.slot)
+    assert ei.value.block_root == bytes(blocks[3].message.tree_hash_root())
+
+
+@pytest.mark.timeout(240)
+def test_tampered_state_root_falls_back_and_names_block(fakebls):
+    """A lying claimed state_root fails the ONE boundary root check;
+    the serial fallback oracle re-runs with full hashing and names the
+    block whose claim is wrong."""
+    fx = _fake_fixture()
+    h = fx["h"]
+    blocks = [b.copy() for b in fx["blocks"][:6]]
+    blocks[-1].message.state_root = b"\xab" * 32
+    rep = EpochReplayer(fx["genesis"].copy(), h.preset, h.spec, h.T,
+                        verify_signatures=False)
+    with pytest.raises(WindowRootMismatch) as ei:
+        rep.apply_window(blocks)
+    assert ei.value.slot == int(blocks[-1].message.slot)
+
+
+@pytest.mark.timeout(240)
+def test_boundary_mismatch_without_fallback_rejects(fakebls):
+    fx = _fake_fixture()
+    h = fx["h"]
+    blocks = [b.copy() for b in fx["blocks"][:5]]
+    blocks[-1].message.state_root = b"\xcd" * 32
+    rep = EpochReplayer(fx["genesis"].copy(), h.preset, h.spec, h.T,
+                        verify_signatures=False, fallback=False)
+    with pytest.raises(WindowRootMismatch):
+        rep.apply_window(blocks)
+
+
+# -- knob ---------------------------------------------------------------------
+
+def test_knob_resolution():
+    with replay_knob(None):          # auto: window length decides
+        assert batch_replay_enabled(8)
+        assert not batch_replay_enabled(2)
+        assert batch_replay_enabled(None)
+    with replay_knob("0"):
+        assert not batch_replay_enabled(128)
+    with replay_knob("1"):
+        assert batch_replay_enabled(1)
+
+
+def _fresh_chain(fx):
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.store import HotColdDB
+
+    h = fx["h"]
+    hdr = fx["genesis"].latest_block_header.copy()
+    hdr.state_root = fx["genesis"].tree_hash_root()
+    return BeaconChain(
+        store=HotColdDB.memory(h.preset, h.spec, h.T),
+        genesis_state=fx["genesis"].copy(),
+        genesis_block_root=hdr.tree_hash_root(),
+        preset=h.preset, spec=h.spec, T=h.T)
+
+
+@pytest.mark.timeout(240)
+def test_knob_off_seam_parity_with_batched_chain(fakebls):
+    """The chain-segment seam lands knob-off (serial oracle) and
+    knob-auto (batched window) imports on identical heads and states."""
+    from lighthouse_tpu.sync import Outcome, process_chain_segment
+
+    fx = _fake_fixture()
+    segment = fx["blocks"][:8]
+
+    with replay_knob("0"):
+        serial_chain = _fresh_chain(fx)
+        res = process_chain_segment(serial_chain, segment)
+        assert res.outcome is Outcome.OK and not res.batched
+        assert res.imported == 8
+    with replay_knob(None):
+        batched_chain = _fresh_chain(fx)
+        res = process_chain_segment(batched_chain, segment)
+        assert res.outcome is Outcome.OK and res.batched
+        assert res.imported == 8
+
+    assert serial_chain.head.root == batched_chain.head.root
+    assert bytes(serial_chain.head.state.tree_hash_root()) == \
+        bytes(batched_chain.head.state.tree_hash_root())
+
+
+# -- range-sync regression: deterministic errors fail the chain NOW -----------
+
+class _StubPeer:
+    def __init__(self, name, blocks):
+        self.name = name
+        self.blocks = blocks
+        self.serves = 0
+
+    def blocks_by_range(self, req):
+        self.serves += 1
+        return [b for b in self.blocks
+                if req.start_slot <= int(b.message.slot)
+                < req.start_slot + req.count]
+
+
+class _StubPeerManager:
+    def __init__(self):
+        self.reports = []
+
+    def best_peers(self, pool):
+        return list(pool)
+
+    def report(self, peer, action):
+        self.reports.append((peer.name, action))
+
+
+class _StubNode:
+    def __init__(self, chain):
+        self.chain = chain
+
+    def _fetch_blobs(self, block):
+        return False
+
+
+@pytest.mark.timeout(240)
+def test_range_sync_deterministic_bad_block_fails_chain_immediately(fakebls):
+    """Regression: a consensus-invalid block is the SAME bytes from
+    every honest peer — the syncing chain must fail after ONE attempt,
+    not burn MAX_BATCH_ATTEMPTS re-downloading the identical batch."""
+    from lighthouse_tpu.network.peer_manager import PeerAction
+    from lighthouse_tpu.network.range_sync import (
+        BatchState,
+        ChainType,
+        SyncingChain,
+    )
+
+    fx = _fake_fixture()
+    bad = [b.copy() for b in fx["blocks"][:5]]
+    bad[-1].message.state_root = b"\xee" * 32  # deterministically invalid
+
+    chain = _fresh_chain(fx)
+    node = _StubNode(chain)
+    pm = _StubPeerManager()
+    peers = [_StubPeer(f"p{i}", bad) for i in range(5)]
+
+    sc = SyncingChain(target_root=b"\x11" * 32,
+                      target_slot=int(bad[-1].message.slot),
+                      start_slot=1,
+                      slots_per_epoch=MINIMAL.SLOTS_PER_EPOCH,
+                      chain_type=ChainType.HEAD)
+    sc.peers = peers
+    for _ in range(20):
+        if not sc.tick(node, pm):
+            break
+    assert sc.failed()
+    failed = [b for b in sc.batches if b.state == BatchState.FAILED]
+    assert len(failed) == 1
+    assert len(failed[0].attempts) == 1, \
+        "deterministic rejection must not rotate peers"
+    assert sum(p.serves for p in peers) == 1
+    assert (failed[0].attempts[0].name,
+            PeerAction.INVALID_MESSAGE) in pm.reports
+
+
+# -- backfill kill-point drill (satellite: both backends) ---------------------
+
+@pytest.mark.timeout(600)
+@pytest.mark.slow
+def test_backfill_kill_point_drill_memory(fakebls):
+    from lighthouse_tpu.testing.crash_drill import (
+        MemoryBackend,
+        backfill_kill_point_drill,
+        build_backfill_fixture,
+    )
+
+    fixture = build_backfill_fixture(slots=20)
+    report = backfill_kill_point_drill(fixture, MemoryBackend(),
+                                       batch_size=8)
+    assert report["failures"] == []
+    assert report["kill_points"] >= 3
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.slow
+def test_backfill_kill_point_drill_sqlite(fakebls, tmp_path):
+    from lighthouse_tpu.testing.crash_drill import (
+        SqliteBackend,
+        backfill_kill_point_drill,
+        build_backfill_fixture,
+        count_backfill_ops,
+    )
+
+    fixture = build_backfill_fixture(slots=20)
+    backend = SqliteBackend(str(tmp_path))
+    total = count_backfill_ops(fixture, backend, batch_size=8)
+    points = sorted({0, total // 2, total - 1})
+    report = backfill_kill_point_drill(fixture, backend,
+                                       kill_points=points, batch_size=8)
+    assert report["failures"] == []

@@ -171,6 +171,29 @@ def test_track_releases_on_gc():
         == before
 
 
+def test_gc_finalizer_release_reentrant_under_ledger_lock():
+    """A tracked owner can be collected while THIS thread already holds
+    the ledger lock (any allocation inside a locked section may trigger
+    GC, and weakref.finalize then runs release -> _adjust_resident on
+    the same thread).  The lock must be reentrant or the process
+    self-deadlocks — observed wedging tier-1 inside mark_slot's
+    slot-base rebuild.  Run the reentrant release on a worker thread so
+    a regression fails the test instead of hanging the suite."""
+    tok = LEDGER.residency("replay")
+    tok.set(4096)
+
+    def reenter():
+        with LEDGER._lock:          # the locked section in progress
+            tok.release()           # the GC finalizer's call shape
+
+    t = threading.Thread(target=reenter, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), \
+        "ResidencyToken.release deadlocked against the held ledger lock"
+    assert LEDGER.snapshot()["subsystems"]["replay"]["resident_bytes"] == 0
+
+
 def test_reset_reseeds_live_tokens():
     """reset() zeroes history but re-seeds residency from live tokens —
     a device object created before the reset must not under-report
