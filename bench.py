@@ -1324,6 +1324,50 @@ def _slasher_bench() -> dict:
                              history=512, per_att=256)
 
 
+def _mesh_slot_bench() -> dict:
+    """PR 20 acceptance row: the full modeled slot through the mesh
+    residency layer, 8 virtual devices vs 1, bit-identity + warm-slot
+    budget + per-shard ledger bytes.  Shells out to
+    ``scripts/validate_mesh.py`` (virtual devices need a fresh process
+    — this one's jax is already initialised); unlosable, rc stays 0:
+    a failure lands in the row, not the run."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "validate_mesh.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # the script sets the device count
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--devices", "8",
+             "--subsystem", "all", "--json"],
+            capture_output=True, text=True, timeout=2400, env=env)
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout \
+            else "{}"
+        res = json.loads(line)
+    except Exception as e:
+        return {"mesh_slot": {"ok": False,
+                              "error": f"{type(e).__name__}: {e}"}}
+    if proc.returncode != 0 and "ok" not in res:
+        return {"mesh_slot": {"ok": False, "rc": proc.returncode,
+                              "stderr": proc.stderr[-400:]}}
+    shards = res.get("shards", {})
+    per_shard_h2d = {
+        sub: {i: row.get("h2d_bytes", 0) for i, row in rows.items()}
+        for sub, rows in shards.items()}
+    return {"mesh_slot": {
+        "ok": bool(res.get("ok")),
+        "devices": res.get("devices"),
+        "subsystems_agree": res.get("subsystems"),
+        "slot_digest_match": res.get("slot_digest_match"),
+        "slot_budget_ok": res.get("slot_budget_ok"),
+        "slot_row_1dev": res.get("slot_row_1dev"),
+        "slot_row_projected": res.get("slot_row_projected"),
+        "per_shard_h2d_bytes": per_shard_h2d,
+    }}
+
+
 def _kzg_bench() -> dict:
     """Deneb data-availability workload: verify_blob_kzg_proof_batch over
     a block's worth of mainnet-width blobs through the device path
@@ -1703,6 +1747,7 @@ _ROWS = [
     ("op_pool", _op_pool_bench, "op_pool_pack_100k", False),
     ("production", _block_production_bench, "block_production", False),
     ("slasher", _slasher_bench, "slasher_span_update_1m", False),
+    ("mesh_slot", _mesh_slot_bench, "mesh_slot", False),
     ("block", _block_transition_bench, "block_transition_128att", False),
     ("block_sigs", _block_with_sigs_bench, "block_with_sigs", False),
     ("trace", _trace_overhead_bench, "trace_overhead", False),
@@ -1844,6 +1889,14 @@ def main() -> None:
     host_only = "--host-only" in sys.argv[1:] \
         or os.environ.get("BENCH_HOST_ONLY") == "1"
     (only,) = _parse_cli(sys.argv[1:])
+    # Sweep temp snapshots stranded by previously killed runs (the
+    # per-run temp below is pid-unique, so anything matching is stale).
+    import glob
+    for stale in glob.glob("*.json.tmp"):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
     if host_only:
         # Pin jax to CPU BEFORE any backend initializes (env vars are
         # too late under this environment's sitecustomize, which already
@@ -1886,64 +1939,78 @@ def main() -> None:
     merged: dict = dict(
         {"backend_error": backend_err} if backend_err else {})
     skipped: list = []
-    for name, fn, metric, needs_device in _ROWS:
-        if only is not None and name not in only:
-            continue
-        if host_only and needs_device:
-            skipped.append(name)
-            _emit({"metric": metric, "skipped": "backend_unavailable"})
-            continue
-        elapsed = time.monotonic() - _T_START
-        if elapsed > BUDGET_S:
-            skipped.append(name)
-            _emit({"metric": metric, "skipped": "budget",
-                   "elapsed_s": round(elapsed, 1)})
-            continue
-        t0 = time.monotonic()
-        faulthandler.dump_traceback_later(row_timeout, exit=True,
-                                          file=sys.stderr)
-        try:
-            row = fn()
-        except Exception as e:  # one bad row must not kill the run
-            traceback.print_exc(file=sys.stderr)
-            _emit({"metric": metric, "error": f"{type(e).__name__}: {e}",
-                   **extra})
-            merged[f"{name}_error"] = f"{type(e).__name__}: {e}"
-            continue
-        finally:
-            faulthandler.cancel_dump_traceback_later()
-            import gc
-            gc.collect()  # free each row's arrays before the next one
-        merged.update(row)
-        _emit({"metric": metric, "row_s": round(time.monotonic() - t0, 1),
-               **row, **extra})
+    # Pid-unique temp: concurrent runs cannot clobber each other's
+    # snapshot mid-write, and the startup sweep can tell it's stale.
+    tmp_path = f"BENCH_LATEST.{os.getpid()}.json.tmp"
+    try:
+        for name, fn, metric, needs_device in _ROWS:
+            if only is not None and name not in only:
+                continue
+            if host_only and needs_device:
+                skipped.append(name)
+                _emit({"metric": metric,
+                       "skipped": "backend_unavailable"})
+                continue
+            elapsed = time.monotonic() - _T_START
+            if elapsed > BUDGET_S:
+                skipped.append(name)
+                _emit({"metric": metric, "skipped": "budget",
+                       "elapsed_s": round(elapsed, 1)})
+                continue
+            t0 = time.monotonic()
+            faulthandler.dump_traceback_later(row_timeout, exit=True,
+                                              file=sys.stderr)
+            try:
+                row = fn()
+            except Exception as e:  # one bad row must not kill the run
+                traceback.print_exc(file=sys.stderr)
+                _emit({"metric": metric,
+                       "error": f"{type(e).__name__}: {e}", **extra})
+                merged[f"{name}_error"] = f"{type(e).__name__}: {e}"
+                continue
+            finally:
+                faulthandler.cancel_dump_traceback_later()
+                import gc
+                gc.collect()  # free each row's arrays before the next
+            merged.update(row)
+            _emit({"metric": metric,
+                   "row_s": round(time.monotonic() - t0, 1),
+                   **row, **extra})
+            combined = _combined(merged, skipped)
+            _emit(combined)  # tail capture always ends on a full record
+            # ATOMICITY: per-row snapshots land in a pid-unique temp;
+            # the real BENCH_LATEST.json is replaced ONCE by the rename
+            # at end of run — a killed run can no longer leave a
+            # truncated/partial artifact that guts the baseline.
+            try:
+                with open(tmp_path, "w") as f:
+                    json.dump(combined, f)
+            except OSError:
+                pass
+
         combined = _combined(merged, skipped)
-        _emit(combined)  # tail capture always ends on a full record
-        # ATOMICITY: per-row snapshots land in a temp file; the real
-        # BENCH_LATEST.json is replaced ONCE by the rename at end of
-        # run — a killed run can no longer leave a truncated/partial
-        # artifact that guts the regression baseline.
+        print(json.dumps(combined))
+        if only is not None:
+            # A subset run would overwrite the full snapshot with a
+            # slice — keep the regression baseline intact.
+            print(json.dumps({"metric": "bench_latest",
+                              "note": "subset run (--only): "
+                                      "BENCH_LATEST.json left "
+                                      "untouched"}))
+            return
         try:
-            with open("BENCH_LATEST.json.tmp", "w") as f:
+            with open(tmp_path, "w") as f:
                 json.dump(combined, f)
+            os.replace(tmp_path, "BENCH_LATEST.json")
         except OSError:
             pass
-
-    combined = _combined(merged, skipped)
-    print(json.dumps(combined))
-    if only is not None:
-        # A subset run would overwrite the full snapshot with a slice —
-        # keep the regression baseline intact and leave only the temp.
-        print(json.dumps({"metric": "bench_latest",
-                          "note": "subset run (--only): "
-                                  "BENCH_LATEST.json left untouched"}))
-        return
-    try:
-        with open("BENCH_LATEST.json.tmp", "w") as f:
-            json.dump(combined, f)
-        os.replace("BENCH_LATEST.json.tmp", "BENCH_LATEST.json")
-    except OSError:
-        pass
+    finally:
+        # Whatever the exit path (subset return, watchdog, exception),
+        # never strand the temp snapshot.
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
 
 
 def _combined(merged: dict, skipped: list) -> dict:

@@ -4,6 +4,7 @@ from . import device_accounting  # noqa: F401
 from . import jax_hygiene    # noqa: F401
 from . import knob_registry  # noqa: F401
 from . import locks          # noqa: F401
+from . import mesh_residency  # noqa: F401
 from . import readme_drift   # noqa: F401
 from . import stage_sources  # noqa: F401
 from . import store_writes   # noqa: F401

@@ -1,0 +1,84 @@
+"""mesh-residency — persistent device state placed through the one mesh.
+
+PR 20 made :mod:`lighthouse_tpu.parallel.mesh` the single residency
+layer: every long-lived device column is registered there
+(``register_column``) and placed/refreshed/pulled through the
+``mesh_put`` / ``mesh_place`` / ``mesh_gather`` seams, which pin the
+column's PartitionSpec on the process mesh and settle wire + per-shard
+bytes into the device ledger.  A raw ``jax.device_put`` inside a
+persistent-residency module re-creates exactly the drift this layer
+removed: an array living outside the registry, invisible to the
+per-shard ledger, replicated when its family says sharded.
+
+Two lexical rules:
+
+1. ``jax.device_put(...)`` (any ``*.device_put`` spelling) inside the
+   PERSISTENT-RESIDENCY modules (:data:`PERSISTENT_MODULES`) — the five
+   subsystems whose arrays outlive a dispatch (resident tree, registry
+   mirror, packed cache, fork-choice mirrors, slasher planes).  Staging
+   pipelines (``parallel/pipeline.py``) and per-dispatch scratch
+   elsewhere stay out of scope: their transfers are transient and
+   ledger-annotated under the device-accounting checker.
+2. ``Mesh(...)`` construction anywhere in ``lighthouse_tpu/`` outside
+   ``parallel/mesh.py`` — ad-hoc meshes fork the axis namespace; the
+   process mesh (``get_mesh``/``make_mesh``) is the one spelling.
+
+Findings are baseline-waivable with justification, like every checker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Checker, Context, Finding, dotted, register
+
+PACKAGE = "lighthouse_tpu/"
+MESH_MODULE = "lighthouse_tpu/parallel/mesh.py"
+
+# The five subsystems whose device arrays persist across dispatches —
+# their placements must route through parallel/mesh.
+PERSISTENT_MODULES = frozenset({
+    "lighthouse_tpu/ops/device_tree.py",
+    "lighthouse_tpu/types/device_state.py",
+    "lighthouse_tpu/types/validators.py",
+    "lighthouse_tpu/fork_choice/device_proto_array.py",
+    "lighthouse_tpu/slasher/device_spans.py",
+})
+
+
+@register
+class MeshResidencyChecker(Checker):
+    name = "mesh-residency"
+    doc = ("raw jax.device_put of long-lived state outside parallel/mesh, "
+           "or an ad-hoc jax.sharding.Mesh outside parallel/mesh.py")
+
+    def check(self, ctx: Context, path: str, tree: ast.AST,
+              lines) -> Iterable[Finding]:
+        if not path.startswith(PACKAGE) or path == MESH_MODULE:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted(node.func) or ""
+            if path in PERSISTENT_MODULES and (
+                    chain == "jax.device_put"
+                    or chain.endswith(".device_put")):
+                out.append(Finding(
+                    self.name, path, node.lineno,
+                    "raw device_put in a persistent-residency module — "
+                    "the array bypasses the mesh column registry and "
+                    "per-shard ledger accounting",
+                    hint="place it via parallel.mesh.mesh_put/mesh_place "
+                         "under a registered column family",
+                    detail="raw-device-put"))
+            elif chain == "Mesh" or chain.endswith(".Mesh"):
+                out.append(Finding(
+                    self.name, path, node.lineno,
+                    "ad-hoc Mesh construction outside parallel/mesh.py "
+                    "— forks the process mesh / axis namespace",
+                    hint="use parallel.mesh.get_mesh() (knob-sized) or "
+                         "make_mesh(devices) from parallel/mesh.py",
+                    detail="adhoc-mesh"))
+        return out

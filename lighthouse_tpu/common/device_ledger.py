@@ -30,6 +30,13 @@ attributed by the fixed :data:`SUBSYSTEMS` enum:
   owning objects (DeviceTree, DeviceRegistryMirror, the fork-choice
   vote mirror, the slasher planes) update at materialize/share/drop
   seams; a dropped owner releases via ``weakref.finalize``.
+- **per-shard transfers** — since the PR-20 mesh layer, every
+  ``parallel/mesh`` placement seam additionally reports the bytes
+  DELIVERED to each mesh shard (:meth:`DeviceLedger.
+  note_shard_transfer`).  Shard rows answer "what landed on device i",
+  so a replicated column counts its full size on EVERY shard (one host
+  copy fans out over ICI) while a batch-sharded column counts 1/d per
+  shard — the per-subsystem families above stay the host-wire totals.
 
 Surfaces:
 
@@ -233,6 +240,10 @@ class DeviceLedger:
         self._sub[UNATTRIBUTED] = dict.fromkeys(_COUNTER_KEYS, 0)
         self._resident: Dict[str, int] = dict.fromkeys(SUBSYSTEMS, 0)
         self._high: Dict[str, int] = dict.fromkeys(SUBSYSTEMS, 0)
+        # Per-shard delivered bytes: subsystem -> shard index ->
+        # {h2d_bytes, d2h_bytes}.  Fed only by the parallel/mesh seams;
+        # empty until the first mesh placement.  guarded-by: _lock
+        self._shards: Dict[str, Dict[int, Dict[str, int]]] = {}
         # Per-slot delta ring: slot → {subsystem: {transfer-key deltas}}.
         self._slot_ring: "OrderedDict[int, dict]" = \
             OrderedDict()  # guarded-by: _lock
@@ -290,6 +301,34 @@ class DeviceLedger:
             row[f"{direction}_bytes"] += int(nbytes)
             row[f"{direction}_ops"] += int(ops)
         self._maybe_install_listener()
+
+    def note_shard_transfer(self, direction: str,
+                            per_shard: Dict[int, int],
+                            subsystem: Optional[str] = None) -> None:
+        """Per-shard DELIVERED bytes for one mesh placement/pull
+        (``parallel/mesh`` seams only).  ``per_shard`` maps mesh shard
+        index → bytes landing on (``"h2d"``) or read from (``"d2h"``)
+        that shard.  A batch-sharded column delivers 1/d per shard, a
+        replicated one its full size on every shard — so shard sums may
+        legitimately exceed the host-wire totals in
+        :meth:`note_transfer` (one host copy fans out over ICI)."""
+        if not self.enabled or not per_shard:
+            return
+        sub = self._resolve(subsystem, "device_tree")
+        key = f"{direction}_bytes"
+        with self._lock:
+            rows = self._shards.setdefault(sub, {})
+            for shard, nbytes in per_shard.items():
+                row = rows.setdefault(
+                    int(shard), {"h2d_bytes": 0, "d2h_bytes": 0})
+                row[key] += int(nbytes)
+
+    def shard_totals(self) -> Dict[str, Dict[int, Dict[str, int]]]:
+        """Per-subsystem per-shard delivered-byte totals (deep copy) —
+        the mesh-slot bench / validate_mesh read surface."""
+        with self._lock:
+            return {s: {i: dict(row) for i, row in rows.items()}
+                    for s, rows in self._shards.items()}
 
     def note_dispatch(self, subsystem: str, wall_ms: float,
                       count: int = 1) -> None:
@@ -511,6 +550,11 @@ class DeviceLedger:
             return {
                 "enabled": self.enabled,
                 "subsystems": subs,
+                # String shard keys: this dict is the JSON body of
+                # /lighthouse/device and int keys would not round-trip.
+                "shards": {s: {str(i): dict(row)
+                               for i, row in sorted(rows.items())}
+                           for s, rows in self._shards.items()},
                 "unattributed_compiles": max(
                     int(un["compiles"] - un["compile_hits"]), 0),
             }
@@ -573,6 +617,7 @@ class DeviceLedger:
             for s in SUBSYSTEMS:
                 self._resident[s] = 0
                 self._high[s] = 0
+            self._shards.clear()
             self._slot_ring.clear()
             self._slot_base = {}
             self._last_slot = None
@@ -725,6 +770,7 @@ LEDGER = DeviceLedger()
 
 attribute = LEDGER.attribute
 note_transfer = LEDGER.note_transfer
+note_shard_transfer = LEDGER.note_shard_transfer
 note_dispatch = LEDGER.note_dispatch
 note_compile = LEDGER.note_compile
 note_event = LEDGER.note_event

@@ -146,6 +146,13 @@ _declare("LIGHTHOUSE_TPU_FORKCHOICE_JIT_MAX_DEPTH", "int", 512,
          "yields to the host walk.", min_value=1)
 
 # -- merkle / device residency --
+_declare("LIGHTHOUSE_TPU_MESH_DEVICES", "int", 0,
+         "Axis size of the process-wide named mesh every device "
+         "subsystem places residency on (parallel/mesh). 0 = auto: "
+         "all local devices on a real TPU backend, 1 otherwise; N "
+         "clamps to the local device count. 1 degenerates every "
+         "sharded column/program to the single-device spelling.",
+         min_value=0, display_default="0 (auto)")
 _declare("LIGHTHOUSE_TPU_PUSH_CHUNK_ROWS", "int", 1 << 18,
          "H2D streaming chunk rows for big column pushes (leaf builds "
          "default 2^18, registry builds 2^17); <= 0 disables "

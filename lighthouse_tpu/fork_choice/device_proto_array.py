@@ -94,30 +94,37 @@ def _bucket(k: int, floor: int = 16) -> int:
 # ---------------------------------------------------------------------------
 
 _KERNELS: dict = {}
+_MESH_KERNELS: dict = {}
 _SCATTERS: dict = {}
 
 
-def _get_kernel(n_pad: int, nv_pad: int):
-    key = (n_pad, nv_pad)
-    fn = _KERNELS.get(key)
-    if fn is not None:
-        return fn
+def _kernel_parts(n_pad: int):
+    """The fused round split at its one mesh-shardable seam: the vote
+    segment-sum runs per validator shard (``local_delta``; partials are
+    exact under an integer ``psum``) and everything node-indexed —
+    proposer boosts included, so a ``psum`` over ``ndev`` shards never
+    multiplies them — stays in the replicated ``propagate`` body.  The
+    1-device fused kernel composes the same two parts back-to-back, so
+    both engines share one arithmetic definition and stay bit-identical.
+    """
     import jax
     import jax.numpy as jnp
-    from jax.experimental import enable_x64
 
     i64 = jnp.int64
+    dummy = n_pad  # scatter sink for "no parent" / "no node"
 
-    def fused(cur, nxt, old_b, new_b, parent, depth, invalid, zroot,
-              viable, rank, weight, bc_in, bd_in, pb_idx, pb_score,
-              b_idx, b_score, max_depth):
-        dummy = n_pad  # scatter sink for "no parent" / "no node"
+    def local_delta(cur, nxt, old_b, new_b):
         # -- vote deltas: two segment scatter-adds over the registry -----
         delta = jnp.zeros(n_pad + 1, i64)
         ci = jnp.where(cur >= 0, cur, dummy)
         delta = delta.at[ci].add(jnp.where(cur >= 0, -old_b, i64(0)))
         ni = jnp.where(nxt >= 0, nxt, dummy)
         delta = delta.at[ni].add(jnp.where(nxt >= 0, new_b, i64(0)))
+        return delta
+
+    def propagate(delta, parent, depth, invalid, zroot, viable, rank,
+                  weight, bc_in, bd_in, pb_idx, pb_score, b_idx, b_score,
+                  max_depth):
         # proposer boost: remove last slot's, add this slot's
         delta = delta.at[jnp.where(pb_idx >= 0, pb_idx, dummy)].add(
             jnp.where(pb_idx >= 0, -pb_score, i64(0)))
@@ -184,6 +191,22 @@ def _get_kernel(n_pad: int, nv_pad: int):
         neg = jnp.any(weight < 0)
         return weight, bc, bd, neg
 
+    return local_delta, propagate
+
+
+def _get_kernel(n_pad: int, nv_pad: int):
+    key = (n_pad, nv_pad)
+    fn = _KERNELS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    from jax.experimental import enable_x64
+
+    local_delta, propagate = _kernel_parts(n_pad)
+
+    def fused(cur, nxt, old_b, new_b, *node_args):
+        return propagate(local_delta(cur, nxt, old_b, new_b), *node_args)
+
     jitted = jax.jit(fused)
 
     def call(*args):
@@ -191,6 +214,44 @@ def _get_kernel(n_pad: int, nv_pad: int):
             return jitted(*args)
 
     _KERNELS[key] = call
+    return call
+
+
+def _get_mesh_kernel(n_pad: int, nv_pad: int):
+    """The fused round as a mesh program: vote/balance columns arrive
+    sharded over the validator (``batch``) axis, each shard scatter-adds
+    its own delta partial, one ``psum`` folds the ``(n_pad + 1,)`` int64
+    partials — exact, adds are associative — and the node-level
+    propagation runs replicated.  Selected only when ``nv_pad`` divides
+    the mesh; caller falls back to :func:`_get_kernel` otherwise."""
+    from ..parallel import mesh as pmesh
+    mesh = pmesh.get_mesh()
+    key = (n_pad, nv_pad, mesh)
+    fn = _MESH_KERNELS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    from jax.experimental import enable_x64
+    from jax.sharding import PartitionSpec as P
+
+    local_delta, propagate = _kernel_parts(n_pad)
+
+    def mesh_fused(cur, nxt, old_b, new_b, *node_args):
+        delta = local_delta(cur, nxt, old_b, new_b)
+        delta = jax.lax.psum(delta, pmesh.BATCH_AXIS)
+        return propagate(delta, *node_args)
+
+    n_node_args = 14  # parent..max_depth, all replicated
+    prog = pmesh.mesh_program(
+        mesh_fused, mesh=mesh,
+        in_specs=(P(pmesh.BATCH_AXIS),) * 4 + (P(),) * n_node_args,
+        out_specs=(P(), P(), P(), P()))
+
+    def call(*args):
+        with enable_x64():
+            return prog(*args)
+
+    _MESH_KERNELS[key] = call
     return call
 
 
@@ -222,9 +283,9 @@ class _DeviceMirror:
 
     def __init__(self, votes: VoteBuffer, old_balances: np.ndarray,
                  n_nodes: int):
-        import jax.numpy as jnp
         from jax.experimental import enable_x64
         from ..common.device_ledger import LEDGER
+        from ..parallel.mesh import mesh_put
 
         self.nv_pad = _bucket(max(len(votes), 1))
         self.n_pad = _bucket(max(n_nodes, 1))
@@ -236,11 +297,9 @@ class _DeviceMirror:
             ob = np.zeros(self.nv_pad, np.int64)
             m = min(old_balances.shape[0], len(votes))
             ob[:m] = old_balances[:m].astype(np.int64)
-            self.cur = jnp.asarray(cur)    # device-io: fork_choice
-            self.nxt = jnp.asarray(nxt)    # device-io: fork_choice
-            self.old_b = jnp.asarray(ob)   # device-io: fork_choice
-        LEDGER.note_transfer("h2d", cur.nbytes + nxt.nbytes + ob.nbytes,
-                             subsystem="fork_choice")
+            self.cur = mesh_put("fc_votes", cur)
+            self.nxt = mesh_put("fc_votes", nxt)
+            self.old_b = mesh_put("fc_votes", ob)
         self.topo_version = -1  # force first topology push
         self.parent = None
         self.depth = None
@@ -272,9 +331,8 @@ class _DeviceMirror:
     def scatter_votes(self, wv: np.ndarray, wn: np.ndarray) -> None:
         if wv.shape[0] == 0:
             return
-        import jax.numpy as jnp
         from jax.experimental import enable_x64
-        from ..common.device_ledger import LEDGER
+        from ..parallel.mesh import mesh_put
         k_pad = _bucket(wv.shape[0], floor=8)
         idx = np.empty(k_pad, np.int32)
         val = np.empty(k_pad, np.int32)
@@ -284,17 +342,15 @@ class _DeviceMirror:
         val[wn.shape[0]:] = wn[0]
         with enable_x64():
             self.nxt = _get_scatter(self.nv_pad, k_pad)(
-                self.nxt, jnp.asarray(idx), jnp.asarray(val))  # device-io: fork_choice
-        LEDGER.note_transfer("h2d", idx.nbytes + val.nbytes,
-                             subsystem="fork_choice")
+                self.nxt, mesh_put("fc_dirty", idx),
+                mesh_put("fc_dirty", val))
         self._note_residency()  # cur/nxt diverge into two buffers here
 
     def push_topology(self, cols: NodeColumns, version: int) -> None:
         if self.topo_version == version and self.parent is not None:
             return
-        import jax.numpy as jnp
         from jax.experimental import enable_x64
-        from ..common.device_ledger import LEDGER
+        from ..parallel.mesh import mesh_put
         n = cols.n
         parent = np.full(self.n_pad, -1, np.int32)
         parent[:n] = cols.parent[:n]
@@ -303,12 +359,9 @@ class _DeviceMirror:
         weight = np.zeros(self.n_pad, np.int64)
         weight[:n] = cols.weight[:n]
         with enable_x64():
-            self.parent = jnp.asarray(parent)   # device-io: fork_choice
-            self.depth = jnp.asarray(depth)     # device-io: fork_choice
-            self.weight = jnp.asarray(weight)   # device-io: fork_choice
-        LEDGER.note_transfer(
-            "h2d", parent.nbytes + depth.nbytes + weight.nbytes,
-            subsystem="fork_choice")
+            self.parent = mesh_put("fc_topology", parent)
+            self.depth = mesh_put("fc_topology", depth)
+            self.weight = mesh_put("fc_topology", weight)
         self.topo_version = version
         self._note_residency()
 
@@ -437,17 +490,14 @@ class DeviceProtoArrayForkChoice:
             if self._pending_new_b is not None and self._mirror is not None:
                 # compute_deltas without an intervening apply: the host
                 # still moves votes/balances — replicate the device move.
-                import jax.numpy as jnp
                 from jax.experimental import enable_x64
+                from ..parallel.mesh import mesh_put
                 nb = np.zeros(self._mirror.nv_pad, np.int64)
                 nb[:self._pending_new_b.shape[0]] = \
                     self._pending_new_b.astype(np.int64)
                 with enable_x64():
-                    self._mirror.old_b = jnp.asarray(nb)  # device-io: fork_choice
+                    self._mirror.old_b = mesh_put("fc_votes", nb)
                     self._mirror.cur = self._mirror.nxt
-                from ..common.device_ledger import LEDGER
-                LEDGER.note_transfer("h2d", nb.nbytes,
-                                     subsystem="fork_choice")
                 self._mirror._note_residency()
                 self._pending_new_b = None
             if self.cols.max_depth() > self.jit_max_depth:
@@ -506,16 +556,13 @@ class DeviceProtoArrayForkChoice:
             np.asarray(new_balances, np.uint64), self.cols.n)
         if self._mirror is not None \
                 and self._mirror.fits(self.votes_store, 1):
-            import jax.numpy as jnp
             from jax.experimental import enable_x64
+            from ..parallel.mesh import mesh_put
             nb = np.zeros(self._mirror.nv_pad, np.int64)
             nb[:new_b.shape[0]] = new_b.astype(np.int64)
             with enable_x64():
-                self._mirror.old_b = jnp.asarray(nb)  # device-io: fork_choice
+                self._mirror.old_b = mesh_put("fc_votes", nb)
                 self._mirror.cur = self._mirror.nxt
-            from ..common.device_ledger import LEDGER
-            LEDGER.note_transfer("h2d", nb.nbytes,
-                                 subsystem="fork_choice")
             self._mirror._note_residency()
             # host apply will move weights: force a weight re-push on
             # the next kernel dispatch even if the topology is unchanged
@@ -565,6 +612,7 @@ class DeviceProtoArrayForkChoice:
         import jax.numpy as jnp
         from jax.experimental import enable_x64
         from ..common.device_ledger import LEDGER
+        from ..parallel import mesh as pmesh
 
         cols = self.cols
         n = cols.n
@@ -591,9 +639,12 @@ class DeviceProtoArrayForkChoice:
         # convention): the np.full marshalling above is host prep, not
         # device-verify time.
         t_dispatch = _time.perf_counter()
+        ndev = pmesh.axis_size()
+        use_mesh = ndev > 1 and mir.nv_pad % ndev == 0
         with enable_x64():
-            kernel = _get_kernel(n_pad, mir.nv_pad)
-            new_b_dev = jnp.asarray(new_b)
+            kernel = (_get_mesh_kernel(n_pad, mir.nv_pad) if use_mesh
+                      else _get_kernel(n_pad, mir.nv_pad))
+            new_b_dev = pmesh.mesh_put("fc_votes", new_b)
             weight, bc, bd, negflag = kernel(
                 mir.cur, mir.nxt, mir.old_b, new_b_dev,
                 mir.parent, mir.depth,
@@ -611,9 +662,11 @@ class DeviceProtoArrayForkChoice:
             bc_host = np.asarray(bc)[:n]       # device-io: fork_choice
             bd_host = np.asarray(bd)[:n]       # device-io: fork_choice
             neg = bool(negflag)
+        # new_b is settled by mesh_put above; these masks ride plain
+        # jnp.asarray into the jit call.
         LEDGER.note_transfer(
             "h2d", inv.nbytes + zr.nbytes + via.nbytes + rank.nbytes
-            + bc_in.nbytes + bd_in.nbytes + new_b.nbytes,
+            + bc_in.nbytes + bd_in.nbytes,
             subsystem="fork_choice")
         LEDGER.note_transfer(
             "d2h", w_host.nbytes + bc_host.nbytes + bd_host.nbytes + 1,
@@ -783,10 +836,14 @@ def warmup(n_nodes: int, n_validators: int) -> None:
     scripts' ``--warmup`` hook; compiles persist via the common cache)."""
     import jax.numpy as jnp
     from jax.experimental import enable_x64
+    from ..parallel import mesh as pmesh
     n_pad = _bucket(n_nodes)
     nv_pad = _bucket(n_validators)
+    ndev = pmesh.axis_size()
     with enable_x64():
-        kernel = _get_kernel(n_pad, nv_pad)
+        kernel = (_get_mesh_kernel(n_pad, nv_pad)
+                  if ndev > 1 and nv_pad % ndev == 0
+                  else _get_kernel(n_pad, nv_pad))
         i32 = jnp.int32
         kernel(jnp.full(nv_pad, -1, i32), jnp.full(nv_pad, -1, i32),
                jnp.zeros(nv_pad, jnp.int64), jnp.zeros(nv_pad, jnp.int64),

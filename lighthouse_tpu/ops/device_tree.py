@@ -125,8 +125,10 @@ def _donation_works() -> bool:
 
 def _bucket(k: int) -> int:
     """Dirty-batch size bucket: power of two ≥ 8 bounds the number of
-    compiled shapes to ~log(max batch)."""
-    return max(_next_pow2(max(k, 1)), 8)
+    compiled shapes to ~log(max batch) (the ``tree_dirty`` family's
+    registered bucket floor)."""
+    from ..parallel.mesh import bucket_rows
+    return bucket_rows("tree_dirty", k)
 
 
 def pad_bucket(idx: np.ndarray, rows: np.ndarray) -> tuple:
@@ -209,6 +211,21 @@ def _use_kernel() -> bool:
     return _use_pallas()
 
 
+def _build_levels(leaves_dev):
+    """Every tree level from device-resident leaves: the sharded mesh
+    program when the process mesh has >1 shard and the width divides it
+    (leaf ranges sharded, top ``log2(ndev)`` levels past the shard
+    boundary), else the 1-device fused body — bit-identical stacks."""
+    from ..parallel import mesh as pmesh
+    if pmesh.axis_size() > 1:
+        from ..parallel.merkle_shard import sharded_tree_levels
+        levels = sharded_tree_levels(
+            leaves_dev, pmesh.get_mesh(), use_kernel=_use_kernel())
+        if levels is not None:
+            return levels
+    return _get_levels_jit()(leaves_dev, use_kernel=_use_kernel())
+
+
 class DeviceTree:
     """One padded Merkle tree whose every level lives on the device.
 
@@ -243,16 +260,16 @@ class DeviceTree:
 
     @classmethod
     def from_host_leaves(cls, leaves: np.ndarray) -> "DeviceTree":
-        """One-time materialization: push the full (w, 8) leaf plane and
-        reduce every level on-device.  The ONLY full-width push this tree
-        ever makes."""
-        import jax
+        """One-time materialization: place the full (w, 8) leaf plane
+        through the mesh seam (sharded over ``batch`` when the process
+        mesh has >1 shard) and reduce every level on-device.  The ONLY
+        full-width push this tree ever makes."""
+        from ..parallel.mesh import mesh_put
         leaves = np.ascontiguousarray(leaves, dtype=np.uint32)
         assert leaves.shape[0] == _next_pow2(leaves.shape[0])
-        note_push(leaves.nbytes)
         LEDGER.note_event("materializes")
-        dev = jax.device_put(leaves)  # device-io: device_tree
-        tree = cls(_get_levels_jit()(dev, use_kernel=_use_kernel()))
+        dev = mesh_put("tree_leaves", leaves)
+        tree = cls(_build_levels(dev))
         tree.note_residency()
         return tree
 
@@ -260,7 +277,7 @@ class DeviceTree:
     def from_device_leaves(cls, leaves) -> "DeviceTree":
         """Rebuild from leaves already resident in HBM — zero push."""
         LEDGER.note_event("rebuilds")
-        tree = cls(_get_levels_jit()(leaves, use_kernel=_use_kernel()))
+        tree = cls(_build_levels(leaves))
         tree.note_residency()
         return tree
 
@@ -276,26 +293,26 @@ class DeviceTree:
 
     def pull_levels(self) -> list:
         """Host copies of every level (de-materialization / oracle)."""
-        out = [np.asarray(lv) for lv in self.levels]
-        note_pull(sum(lv.nbytes for lv in out))
-        return out
+        from ..parallel.mesh import mesh_gather
+        return [mesh_gather(lv, name="tree_leaves")
+                for lv in self.levels]
 
     # -- updates -------------------------------------------------------------
 
-    def scatter(self, idx: np.ndarray, rows: np.ndarray) -> np.ndarray:  # device-io: device_tree
+    def scatter(self, idx: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """Warm update: ``rows`` (k, 8) u32 replace leaves at ``idx``
         (ascending, unique); returns the new subtree root words.  H2D is
-        the bucket-padded (idx, rows) pair only."""
+        the bucket-padded (idx, rows) pair only (the replicated
+        ``tree_dirty`` mesh family)."""
         if idx.size == 0:
             return self.root_words()
-        import jax
+        from ..parallel.mesh import mesh_put
         pidx, prows = pad_bucket(np.asarray(idx),
                                  np.ascontiguousarray(rows, dtype=np.uint32))
-        note_push(pidx.nbytes + prows.nbytes)
         LEDGER.note_event("scatters")
         jit = _get_scatter_jit(_donation_works() and not self.shared)
-        self.levels = jit(self.levels, jax.device_put(pidx),  # device-io: device_tree
-                          jax.device_put(prows))
+        self.levels = jit(self.levels, mesh_put("tree_dirty", pidx),
+                          mesh_put("tree_dirty", prows))
         self.shared = False  # the update produced buffers only we hold
         self.note_residency()
         return self.root_words()
@@ -314,7 +331,7 @@ class DeviceTree:
         """Replace every level from device-resident leaves (dirty fraction
         past the walk/rebuild crossover, or width growth) — zero push."""
         LEDGER.note_event("rebuilds")
-        self.levels = _get_levels_jit()(leaves, use_kernel=_use_kernel())
+        self.levels = _build_levels(leaves)
         self.shared = False
         self.note_residency()
         return self.root_words()

@@ -1,0 +1,320 @@
+"""One modeled slot through every mesh-resident subsystem.
+
+The shared driver behind ``dryrun_multichip``, ``scripts/validate_mesh.py``
+and the bench ``mesh_slot`` row.  A modeled slot exercises the per-slot
+device pipeline end to end on whatever mesh the process knob resolves —
+registry scatter + mirror rebuild (verify/transition stand-in), the
+packed-column cache root, a fork-choice attestation round through the
+fused (or mesh) kernel, and a slasher span ingest — with stage wall
+times, the ledger's per-slot transfer deltas, and the per-shard byte
+rows captured into one trace row per slot.
+
+Every scenario here is deterministic (seeded, no wall-clock inputs), so
+the SAME model run under ``LIGHTHOUSE_TPU_MESH_DEVICES=N`` and ``=1``
+must produce bit-identical roots, heads and span planes — that is the
+differential ``check_subsystem`` runs and the acceptance gate of PR 20.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import numpy as np
+
+SUBSYSTEM_CHOICES = ("tree", "registry", "packed", "forkchoice",
+                     "slasher", "all")
+
+_SLOT_BASE = [1_000_000]  # distinct slot numbers per model run (the
+#                           ledger ring is idempotent per slot value)
+
+
+def _root(i: int) -> bytes:
+    return int(i).to_bytes(4, "little") + b"\xcd" * 28
+
+
+@contextmanager
+def forced_devices(n: int):
+    """Temporarily pin the mesh knob to ``n`` devices (and back)."""
+    import os
+    from . import mesh as pmesh
+    # Prior value through the registry's raw accessor (knob-registry
+    # invariant: env reads live in common/knobs.py; writes are ours).
+    from ..common.knobs import _raw
+    old = _raw("LIGHTHOUSE_TPU_MESH_DEVICES")
+    os.environ["LIGHTHOUSE_TPU_MESH_DEVICES"] = str(int(n))
+    pmesh.reset_mesh()
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("LIGHTHOUSE_TPU_MESH_DEVICES", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_MESH_DEVICES"] = old
+        pmesh.reset_mesh()
+
+
+def _make_registry(n: int, rng: np.random.Generator):
+    from ..types.validators import ValidatorRegistry
+    reg = ValidatorRegistry(n)
+    reg._pubkey[:n] = rng.integers(0, 256, (n, 48), dtype=np.uint8)
+    reg._withdrawal_credentials[:n] = rng.integers(
+        0, 256, (n, 32), dtype=np.uint8)
+    reg._effective_balance[:n] = (rng.integers(16, 33, n).astype(np.uint64)
+                                  * np.uint64(10 ** 9))
+    reg._activation_epoch[:n] = np.arange(n, dtype=np.uint64) % 7
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Per-subsystem deterministic scenarios (each returns a digest of every
+# observable device output; compared N-device vs 1-device bit-for-bit)
+# ---------------------------------------------------------------------------
+
+def _scenario_tree(seed: int = 0, w: int = 256) -> bytes:
+    from ..ops.device_tree import DeviceTree
+    rng = np.random.default_rng(seed)
+    leaves = rng.integers(0, 2 ** 32, (w, 8), dtype=np.uint32)
+    t = DeviceTree.from_host_leaves(leaves)
+    h = hashlib.sha256(np.asarray(t.root_words()).tobytes())
+    idx = np.asarray([1, 7, w // 2, w - 1], np.int64)
+    rows = rng.integers(0, 2 ** 32, (idx.shape[0], 8), dtype=np.uint32)
+    h.update(np.asarray(t.scatter(idx, rows)).tobytes())
+    for lv in t.pull_levels():
+        h.update(np.asarray(lv).tobytes())
+    return h.digest()
+
+
+def _scenario_registry(seed: int = 0, n: int = 200) -> bytes:
+    from ..types.validators import DeviceRegistryMirror
+    rng = np.random.default_rng(seed)
+    reg = _make_registry(n, rng)
+    mir = DeviceRegistryMirror.materialize(reg)
+    h = hashlib.sha256(np.asarray(mir.tree.root_words()).tobytes())
+    idx = np.asarray([3, n // 3, n - 1], np.int64)
+    reg._effective_balance[idx] += np.uint64(1)
+    h.update(np.asarray(mir.scatter_records(reg, idx)).tobytes())
+    h.update(np.asarray(mir.rebuild(reg._n)).tobytes())
+    return h.digest()
+
+
+def _scenario_packed(seed: int = 0, n: int = 1024) -> bytes:
+    from ..types.device_state import DevicePackedCache
+    rng = np.random.default_rng(seed)
+    col = rng.integers(0, 2 ** 62, n).astype(np.uint64)
+    cache = DevicePackedCache(limit_chunks=1 << 12, mixin_length=True)
+    h = hashlib.sha256(cache.root(col))
+    col = col.copy()
+    col[[0, n // 2, n - 1]] += np.uint64(7)  # warm scatter path
+    h.update(cache.root(col))
+    return h.digest()
+
+
+def _scenario_forkchoice(seed: int = 0, nv: int = 64,
+                         rounds: int = 3) -> bytes:
+    from ..fork_choice.device_proto_array import DeviceProtoArrayForkChoice
+    from ..fork_choice.proto_array import EXEC_OPTIMISTIC, ZERO_ROOT
+    rng = np.random.default_rng(seed)
+    fc = DeviceProtoArrayForkChoice(engine="jit")
+    fc.on_block(slot=0, root=_root(0), parent_root=ZERO_ROOT,
+                state_root=_root(0), justified_epoch=1,
+                justified_root=_root(0), finalized_epoch=1,
+                finalized_root=_root(0),
+                execution_status=EXEC_OPTIMISTIC)
+    h = hashlib.sha256()
+    cp = (1, _root(0))
+    for s in range(1, rounds + 1):
+        # two competing children per round keeps best-child selection live
+        for b in range(2):
+            fc.on_block(slot=s, root=_root(2 * s + b),
+                        parent_root=_root(max(2 * (s - 1), 0)),
+                        state_root=_root(2 * s + b), justified_epoch=1,
+                        justified_root=_root(0), finalized_epoch=1,
+                        finalized_root=_root(0),
+                        execution_status=EXEC_OPTIMISTIC)
+        committee = rng.choice(nv, size=nv // 2, replace=False)
+        fc.process_attestation_batch(
+            [(committee.astype(np.int64), _root(2 * s), s)])
+        bal = rng.integers(1, 100, nv).astype(np.uint64)
+        deltas = fc.compute_deltas(bal)
+        fc.apply_score_changes(deltas, cp, cp, ZERO_ROOT, 0, s)
+        head = fc.find_head(_root(0), s)
+        h.update(head)
+        h.update(fc.cols.weight[:fc.cols.n].tobytes())
+    return h.digest()
+
+
+def _scenario_slasher(seed: int = 0, n: int = 256,
+                      history: int = 64) -> bytes:
+    from ..slasher.device_spans import DeviceSpanPlane
+    rng = np.random.default_rng(seed)
+    plane = DeviceSpanPlane(n, history=history)
+    h = hashlib.sha256()
+    for e in range(3, 6):
+        idx = np.sort(rng.choice(n, size=n // 4, replace=False))
+        pre = plane.ingest(plane.group([(e - 2, e, idx),
+                                        (e - 1, e, idx[: n // 8])]))
+        for key in sorted(pre):
+            h.update(pre[key][0].tobytes())
+            h.update(pre[key][1].tobytes())
+    mn, mx = plane.to_host()
+    h.update(mn.tobytes())
+    h.update(mx.tobytes())
+    return h.digest()
+
+
+_SCENARIOS = {
+    "tree": _scenario_tree,
+    "registry": _scenario_registry,
+    "packed": _scenario_packed,
+    "forkchoice": _scenario_forkchoice,
+    "slasher": _scenario_slasher,
+}
+
+
+def check_subsystem(name: str, seed: int = 0) -> dict:
+    """Run one subsystem scenario on the current mesh AND forced to one
+    device; returns ``{"subsystem", "devices", "match"}``.  Bit-identity
+    is the contract — sharded programs reuse the 1-device fold order."""
+    from . import mesh as pmesh
+    fn = _SCENARIOS[name]
+    ndev = pmesh.axis_size()
+    mesh_digest = fn(seed)
+    with forced_devices(1):
+        ref_digest = fn(seed)
+    return {"subsystem": name, "devices": ndev,
+            "match": mesh_digest == ref_digest}
+
+
+# ---------------------------------------------------------------------------
+# The full modeled slot: verify/transition stand-in -> root -> fork
+# choice -> slasher, per-slot ledger deltas + budget verdict
+# ---------------------------------------------------------------------------
+
+def run_slot_model(*, n_validators: int = 256, slots: int = 3,
+                   history: int = 64, seed: int = 0) -> dict:
+    """Drive ``slots`` modeled slots over every subsystem on the current
+    mesh.  Returns ``{"devices", "digest", "rows", "budget",
+    "shards"}`` — ``digest`` is the bit-exact observable-output hash
+    (compare across device counts), ``rows`` one trace row per slot with
+    per-stage wall ms, ``budget`` the warm-slot transfer verdict over the
+    non-cold slots, ``shards`` the per-shard ledger byte rows."""
+    from . import mesh as pmesh
+    from ..common import device_ledger as DL
+    from ..common.device_ledger import LEDGER
+    from ..fork_choice.device_proto_array import DeviceProtoArrayForkChoice
+    from ..fork_choice.proto_array import EXEC_OPTIMISTIC, ZERO_ROOT
+    from ..slasher.device_spans import DeviceSpanPlane
+    from ..types.device_state import DevicePackedCache
+    from ..types.validators import DeviceRegistryMirror
+
+    ndev = pmesh.axis_size()
+    rng = np.random.default_rng(seed)
+    base = _SLOT_BASE[0]
+    _SLOT_BASE[0] += slots + 2
+    digest = hashlib.sha256()
+
+    # -- cold setup (the materialize slot; excluded from the budget) ----
+    reg = _make_registry(n_validators, rng)
+    mirror = DeviceRegistryMirror.materialize(reg)
+    balances = reg._effective_balance[:n_validators].copy()
+    cache = DevicePackedCache(limit_chunks=1 << 12, mixin_length=True)
+    cache.root(balances)
+    fc = DeviceProtoArrayForkChoice(engine="jit")
+    fc.on_block(slot=0, root=_root(0), parent_root=ZERO_ROOT,
+                state_root=_root(0), justified_epoch=1,
+                justified_root=_root(0), finalized_epoch=1,
+                finalized_root=_root(0),
+                execution_status=EXEC_OPTIMISTIC)
+    plane = DeviceSpanPlane(n_validators, history=history)
+    cp = (1, _root(0))
+    LEDGER.mark_slot(base)
+
+    rows = []
+    for s in range(1, slots + 1):
+        row: Dict[str, object] = {"slot": s, "devices": ndev}
+
+        # verify/transition stand-in: per-slot balance updates scatter
+        # into the resident registry mirror, epoch-style full rebuild
+        t0 = time.perf_counter()
+        idx = np.sort(rng.choice(n_validators, size=max(n_validators // 8, 1),
+                                 replace=False)).astype(np.int64)
+        reg._effective_balance[idx] += np.uint64(s)
+        digest.update(np.asarray(mirror.scatter_records(reg, idx)).tobytes())
+        digest.update(np.asarray(mirror.rebuild(reg._n)).tobytes())
+        row["registry_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+        # state root: the packed balance column through the device cache
+        t0 = time.perf_counter()
+        balances[idx] += np.uint64(s)
+        digest.update(cache.root(balances))
+        row["packed_root_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+        # fork choice: new block + one committee's attestations + head
+        t0 = time.perf_counter()
+        fc.on_block(slot=s, root=_root(s), parent_root=_root(s - 1),
+                    state_root=_root(s), justified_epoch=1,
+                    justified_root=_root(0), finalized_epoch=1,
+                    finalized_root=_root(0),
+                    execution_status=EXEC_OPTIMISTIC)
+        committee = rng.choice(n_validators, size=n_validators // 3,
+                               replace=False).astype(np.int64)
+        fc.process_attestation_batch([(committee, _root(s), s)])
+        deltas = fc.compute_deltas(balances)
+        fc.apply_score_changes(deltas, cp, cp, ZERO_ROOT, 0, s)
+        head = fc.find_head(_root(0), s)
+        digest.update(head)
+        row["fork_choice_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        row["head"] = head[:4].hex()
+
+        # slasher: the slot's grouped attestations sweep the span planes
+        t0 = time.perf_counter()
+        pre = plane.ingest(plane.group(
+            [(s + 1, s + 3, np.sort(committee).astype(np.int64))]))
+        for key in sorted(pre):
+            digest.update(pre[key][0].tobytes())
+            digest.update(pre[key][1].tobytes())
+        row["slasher_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+        LEDGER.mark_slot(base + s)
+        rows.append(row)
+
+    mn, mx = plane.to_host()
+    digest.update(mn.tobytes())
+    digest.update(mx.tobytes())
+
+    window = [d for d in LEDGER.slot_deltas()
+              if base <= d["slot"] < base + slots]
+    budget = DL.evaluate_budget(window, include_cold=False) \
+        if window else {"ok": True, "rows": [], "attainment": 1.0}
+    return {
+        "devices": ndev,
+        "digest": digest.hexdigest(),
+        "rows": rows,
+        "budget": budget,
+        "shards": LEDGER.shard_totals(),
+    }
+
+
+def projected_slot_row(row_1dev: dict, n_chips: int,
+                       sharded_fraction: float = 0.85) -> dict:
+    """Project a measured 1-device slot trace row onto an ``n_chips``
+    mesh: the validator-axis stages divide by the chip count while the
+    replicated top folds / propagate / collectives do not (held at
+    ``1 - sharded_fraction`` of each stage, the same split the mesh
+    programs encode).  A projection, not a measurement — the hardware
+    row stays a ROADMAP remainder."""
+    stages = ("registry_ms", "packed_root_ms", "fork_choice_ms",
+              "slasher_ms")
+    out = {"slot": row_1dev.get("slot"), "devices": n_chips,
+           "projected": True}
+    total = 0.0
+    for k in stages:
+        ms = float(row_1dev[k])
+        proj = ms * sharded_fraction / n_chips + ms * (1 - sharded_fraction)
+        out[k] = round(proj, 2)
+        total += proj
+    out["slot_ms"] = round(total, 2)
+    return out

@@ -117,14 +117,19 @@ class DeviceSpanPlane:
 
     def __init__(self, n_validators: int, history: int = 1024):
         from ..common.device_ledger import LEDGER
+        from ..parallel.mesh import mesh_place
         self.n = n_validators
         self.history = history
-        self.min_plane = jnp.full((n_validators, history), _NO_MIN,
-                                  jnp.uint16)
-        self.max_plane = jnp.full((n_validators, history), _NO_MAX,
-                                  jnp.uint16)
-        # Device-side fills — zero H2D, but 2 planes of HBM residency
-        # (the GC finalizer releases them with the plane object).
+        # Device-side fills placed on the process mesh — the validator
+        # axis shards over ``batch`` when it divides, so each chip holds
+        # ``2nH/d`` bytes of plane; zero H2D either way.
+        self.min_plane = mesh_place(
+            "slasher_planes",
+            jnp.full((n_validators, history), _NO_MIN, jnp.uint16))
+        self.max_plane = mesh_place(
+            "slasher_planes",
+            jnp.full((n_validators, history), _NO_MAX, jnp.uint16))
+        # (the GC finalizer releases the residency with the plane object)
         self._res = LEDGER.track(
             self, "slasher",
             int(self.min_plane.nbytes) + int(self.max_plane.nbytes))
@@ -160,6 +165,7 @@ class DeviceSpanPlane:
                     f"span distance {t - s} exceeds the history window "
                     f"{self.history}; clamp upstream")
         from ..common.device_ledger import LEDGER
+        from ..parallel.mesh import mesh_gather, mesh_put
         pre: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
         for at in range(0, len(groups), _MAX_GROUPS):
             chunk = groups[at:at + _MAX_GROUPS]
@@ -177,30 +183,28 @@ class DeviceSpanPlane:
                 live[i] = True
                 gidx[i, :len(idx)] = idx
             packed = np.packbits(masks, axis=1, bitorder="little")
-            LEDGER.note_transfer(
-                "h2d", packed.nbytes + sources.nbytes + targets.nbytes
-                + live.nbytes + gidx.nbytes, subsystem="slasher")
             t0 = time.perf_counter()
             self.min_plane, self.max_plane, g_min, g_max = _ingest_kernel(  # device-io: slasher
-                self.min_plane, self.max_plane, jnp.asarray(packed),
-                jnp.asarray(sources), jnp.asarray(targets),
-                jnp.asarray(live), jnp.asarray(gidx))
-            g_min = np.asarray(g_min)   # device-io: slasher
-            g_max = np.asarray(g_max)   # device-io: slasher
+                self.min_plane, self.max_plane,
+                mesh_put("slasher_groups", packed, subsystem="slasher"),
+                mesh_put("slasher_groups", sources, subsystem="slasher"),
+                mesh_put("slasher_groups", targets, subsystem="slasher"),
+                mesh_put("slasher_groups", live, subsystem="slasher"),
+                mesh_put("slasher_groups", gidx, subsystem="slasher"))
+            g_min = mesh_gather(g_min, subsystem="slasher")
+            g_max = mesh_gather(g_max, subsystem="slasher")
             LEDGER.note_dispatch("slasher",
                                  (time.perf_counter() - t0) * 1e3)
-            LEDGER.note_transfer("d2h", g_min.nbytes + g_max.nbytes,
-                                 subsystem="slasher")
             for i, (s, t, idx) in enumerate(chunk):
                 pre[(s, t)] = (g_min[i, :len(idx)], g_max[i, :len(idx)])
         return pre
 
     def to_host(self) -> Tuple[np.ndarray, np.ndarray]:
-        from ..common.device_ledger import LEDGER
-        mn = np.asarray(self.min_plane)  # device-io: slasher
-        mx = np.asarray(self.max_plane)  # device-io: slasher
-        LEDGER.note_transfer("d2h", mn.nbytes + mx.nbytes,
-                             subsystem="slasher")
+        from ..parallel.mesh import mesh_gather
+        mn = mesh_gather(self.min_plane, subsystem="slasher",
+                         name="slasher_planes")
+        mx = mesh_gather(self.max_plane, subsystem="slasher",
+                         name="slasher_planes")
         return mn, mx
 
 

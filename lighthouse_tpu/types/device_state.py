@@ -37,7 +37,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..common.device_ledger import LEDGER
-from ..ops.device_tree import DeviceTree, note_push, residency_snapshot
+from ..ops.device_tree import DeviceTree, residency_snapshot
 from ..ops.merkle import _next_pow2
 from ..ops.tree_cache import fold_zero_cap
 
@@ -116,8 +116,8 @@ class DeviceColumn:
     # -- host/device plumbing ------------------------------------------------
 
     def _pull(self) -> None:
-        host = np.asarray(self._dev)  # device-io: packed_cache
-        LEDGER.note_transfer("d2h", host.nbytes, subsystem="packed_cache")
+        from ..parallel.mesh import mesh_gather
+        host = mesh_gather(self._dev, subsystem="packed_cache")
         object.__setattr__(self, "_host", host.copy()
                            if not host.flags.writeable else host)
         object.__setattr__(self, "_stale", False)
@@ -365,9 +365,9 @@ class DevicePackedCache:
         paid only when host-side mutation resumes — which implies the host
         needed the values anyway)."""
         if self.src is None and self.src_dev is not None:
-            self.src = np.asarray(self.src_dev).copy()  # device-io: packed_cache
-            LEDGER.note_transfer("d2h", self.src.nbytes,
-                                 subsystem="packed_cache")
+            from ..parallel.mesh import mesh_gather
+            self.src = mesh_gather(
+                self.src_dev, subsystem="packed_cache").copy()
             self.src_dev = None
 
     def _host_rebuild(self, host: np.ndarray, w: int) -> np.ndarray:
@@ -378,9 +378,10 @@ class DevicePackedCache:
         if self.tree is None:
             self.tree = DeviceTree.from_host_leaves(leaves)
         else:
-            note_push(leaves.nbytes)
-            import jax
-            self.tree.rebuild_device(jax.device_put(leaves))  # device-io: packed_cache
+            from ..parallel.mesh import mesh_put
+            self.tree.rebuild_device(
+                mesh_put("packed_leaves", leaves,
+                         subsystem="packed_cache"))
         self.src = host.copy()
         self.src_dev = None
         return self.tree.root_words()

@@ -581,13 +581,10 @@ def registry_cold_device(reg: "ValidatorRegistry",
     use_kernel = _use_pallas()
     chunk = _reg_chunk_rows() if chunk_rows is None else chunk_rows
     if chunk <= 0 or m <= chunk or chunk % _PALLAS_PAD:
-        from ..common.device_ledger import LEDGER
+        from ..parallel.mesh import mesh_put
         t0 = time.perf_counter()
         host_cols = _registry_raw_columns(reg, m)
-        LEDGER.note_transfer(
-            "h2d", sum(v.nbytes for v in host_cols.values()),
-            subsystem="staging")
-        cols = {k: jax.device_put(v)  # device-io: staging
+        cols = {k: mesh_put("registry_cols", v, subsystem="staging")
                 for k, v in host_cols.items()}
         jax.block_until_ready(cols)
         t1 = time.perf_counter()
@@ -643,8 +640,7 @@ def registry_cold_device(reg: "ValidatorRegistry",
 
 def registry_device_columns(reg: "ValidatorRegistry") -> dict:
     """Push the registry columns to the device once (HBM residency)."""
-    import jax
-    from ..common.device_ledger import LEDGER
+    from ..parallel.mesh import mesh_put
     n = reg._n
     host = {
         "pubkey": bytes_col_to_words(reg._pubkey[:n]),
@@ -659,9 +655,8 @@ def registry_device_columns(reg: "ValidatorRegistry") -> dict:
         "withdrawable_epoch":
             u64_to_chunk_words(reg._withdrawable_epoch[:n]),
     }
-    LEDGER.note_transfer("h2d", sum(v.nbytes for v in host.values()),
-                         subsystem="staging")
-    return {k: jax.device_put(v) for k, v in host.items()}  # device-io: staging
+    return {k: mesh_put("registry_cols", v, subsystem="staging")
+            for k, v in host.items()}
 
 
 def _registry_root_fused(cols: dict, *, depth: int, chunk_log2: int,
@@ -837,6 +832,72 @@ def _get_mirror_rebuild_jit():
     return _mirror_rebuild_jit
 
 
+_mirror_rebuild_mesh_programs: dict = {}
+
+
+def _get_mirror_rebuild_mesh(mesh, local_w: int):
+    """The rebuild as a mesh program: record mini-trees + the level fold
+    are per-shard over a contiguous record range (the SSZ count mask
+    needs GLOBAL row indices, so the shard offsets its ``arange`` by
+    ``axis_index * local_w``); the top ``log2(ndev)`` levels fold past
+    the shard boundary.  Bit-identical to ``_mirror_rebuild_body``
+    (same fold order; XLA hash64 — the Pallas lane floor exceeds a
+    shard's rows at differential widths)."""
+    key = (mesh, local_w)
+    prog = _mirror_rebuild_mesh_programs.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.mesh import BATCH_AXIS, mesh_program
+    from ..parallel.merkle_shard import _get_top_fold
+    from ..ops.sha256 import hash64
+
+    def local_levels(cols, n_arr):
+        rec = _record_roots_body(cols, use_kernel=False)
+        base = jax.lax.axis_index(BATCH_AXIS).astype(jnp.uint32) \
+            * jnp.uint32(local_w)
+        keep = (base + jnp.arange(local_w, dtype=jnp.uint32)
+                < n_arr)[:, None]
+        rec = jnp.where(keep, rec, jnp.zeros_like(rec))
+        levels = [rec]
+        cur = rec
+        while cur.shape[0] > 1:
+            cur = hash64(cur[0::2], cur[1::2])
+            levels.append(cur)
+        return tuple(levels)
+
+    n_local = local_w.bit_length()  # log2(local_w) + 1 local levels
+    lower = mesh_program(
+        local_levels, mesh=mesh,
+        in_specs=(P(BATCH_AXIS), P()),
+        out_specs=tuple(P(BATCH_AXIS) for _ in range(n_local)))
+
+    def run(cols, n_arr):
+        low = lower(cols, n_arr)
+        return tuple(low) + tuple(_get_top_fold()(low[-1]))
+
+    _mirror_rebuild_mesh_programs[key] = run
+    return run
+
+
+def _mirror_levels(cols: dict, n: int):
+    """Every record-tree level from the HBM columns: the sharded mesh
+    program when the process mesh has >1 shard and the width divides it,
+    else the 1-device fused body."""
+    from ..ops.merkle_kernel import _use_pallas
+    from ..parallel import mesh as pmesh
+    w = cols["slashed"].shape[0]
+    ndev = pmesh.axis_size()
+    if ndev > 1 and ndev & (ndev - 1) == 0 and w % ndev == 0 \
+            and w // ndev >= 2:
+        return _get_mirror_rebuild_mesh(pmesh.get_mesh(), w // ndev)(
+            cols, np.uint32(n))
+    return _get_mirror_rebuild_jit()(cols, np.uint32(n),
+                                     use_kernel=_use_pallas())
+
+
 class DeviceRegistryMirror:
     """HBM-resident raw columns + record-root tree for one registry
     lineage (COW across :meth:`ValidatorRegistry.copy`)."""
@@ -870,60 +931,65 @@ class DeviceRegistryMirror:
         """One-time column push (chunk-staged for big registries, like the
         cold build) + in-HBM level reduction.  This is the LAST full-width
         push this lineage ever makes."""
-        import jax
         import jax.numpy as jnp
         from ..common.device_ledger import LEDGER
-        from ..ops.device_tree import DeviceTree, note_push
+        from ..ops.device_tree import DeviceTree
         from ..ops.merkle import _next_pow2
-        from ..ops.merkle_kernel import _use_pallas
+        from ..parallel.mesh import mesh_place, mesh_put
 
         n = reg._n
         w = _next_pow2(max(n, 1))
         with LEDGER.attribute("registry_mirror"):
             host = _registry_raw_columns(reg, w)
-            note_push(sum(v.nbytes for v in host.values()))
             LEDGER.note_event("materializes")
             chunk = _reg_chunk_rows()
             if chunk > 0 and w > chunk and w % chunk == 0:
                 from ..parallel.pipeline import ChunkStager
                 chunks = [{k: v[b:b + chunk] for k, v in host.items()}
                           for b in range(0, w, chunk)]
-                # subsystem=None: the full-width push is accounted once
-                # above — the stager must not double-count it.
+                # subsystem=None: the streamed push settles its wire
+                # total + per-shard split at the mesh_place seam below —
+                # the stager must not double-count it.
                 parts = list(ChunkStager(chunks, subsystem=None))
-                cols = {k: jnp.concatenate([p[k] for p in parts], axis=0)
+                cols = {k: mesh_place(
+                            "registry_cols",
+                            jnp.concatenate([p[k] for p in parts],
+                                            axis=0),
+                            h2d_bytes=host[k].nbytes)
                         for k in host}
             else:
-                cols = {k: jax.device_put(v) for k, v in host.items()}  # device-io: registry_mirror
-            levels = _get_mirror_rebuild_jit()(
-                cols, np.uint32(n), use_kernel=_use_pallas())
+                cols = {k: mesh_put("registry_cols", v)
+                        for k, v in host.items()}
+            levels = _mirror_levels(cols, n)
             from ..ops.tree_cache import HASH_COUNT
             HASH_COUNT[0] += 8 * w + (w - 1)
             mirror = cls(cols, DeviceTree(levels), False)
             mirror.note_residency()
             return mirror
 
-    def scatter_records(self, reg: "ValidatorRegistry",  # device-io: registry_mirror
+    def scatter_records(self, reg: "ValidatorRegistry",
                         idx: np.ndarray) -> np.ndarray:
         """Land ``idx`` dirty records as one fused device dispatch; returns
-        the new subtree root words.  H2D = the bucket-padded raw rows."""
-        import jax
+        the new subtree root words.  H2D = the bucket-padded raw rows
+        (the replicated ``registry_dirty`` mesh family)."""
         from ..common.device_ledger import LEDGER
-        from ..ops.device_tree import _donation_works, note_push
+        from ..ops.device_tree import _donation_works
         from ..ops.tree_cache import HASH_COUNT
+        from ..parallel.mesh import mesh_put
 
         with LEDGER.attribute("registry_mirror"):
             pidx, rows = _pad_rows_bucket(np.asarray(idx),
                                           _registry_raw_rows(reg, idx))
-            note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
             LEDGER.note_event("scatters")
             HASH_COUNT[0] += pidx.shape[0] * (8 + len(self.tree.levels) - 1)
             jit = _get_mirror_scatter_jit(
                 _donation_works() and not self.shared
                 and not self.tree.shared)
             self.cols, self.tree.levels = jit(
-                self.tree.levels, self.cols, jax.device_put(pidx),  # device-io: registry_mirror
-                {k: jax.device_put(v) for k, v in rows.items()})
+                self.tree.levels, self.cols,
+                mesh_put("registry_dirty", pidx),
+                {k: mesh_put("registry_dirty", v)
+                 for k, v in rows.items()})
             self.shared = False
             self.tree.shared = False
             self.note_residency()
@@ -934,31 +1000,28 @@ class DeviceRegistryMirror:
         """Update only the HBM columns at ``idx`` (no tree propagation) —
         the prelude to :meth:`rebuild` when the dirty fraction or a width
         change makes path-walking the wrong tool."""
-        import jax
         from ..common.device_ledger import LEDGER
-        from ..ops.device_tree import note_push
+        from ..parallel.mesh import mesh_put
 
         with LEDGER.attribute("registry_mirror"):
             pidx, rows = _pad_rows_bucket(np.asarray(idx),
                                           _registry_raw_rows(reg, idx))
-            note_push(pidx.nbytes + sum(v.nbytes for v in rows.values()))
-            idx_dev = jax.device_put(pidx)  # device-io: registry_mirror
+            idx_dev = mesh_put("registry_dirty", pidx)
             for k in self.cols:
                 self.cols[k] = self.cols[k].at[idx_dev].set(
-                    jax.device_put(rows[k]))  # device-io: registry_mirror
+                    mesh_put("registry_dirty", rows[k]))
             self.shared = False
 
     def rebuild(self, n: int) -> np.ndarray:
-        """Re-reduce every level from the HBM columns — zero push."""
+        """Re-reduce every level from the HBM columns — zero push (a
+        sharded mesh program when the process mesh has >1 shard)."""
         from ..common.device_ledger import LEDGER
-        from ..ops.merkle_kernel import _use_pallas
         from ..ops.tree_cache import HASH_COUNT
 
         LEDGER.note_event("rebuilds", subsystem="registry_mirror")
         w = self.width
         HASH_COUNT[0] += 8 * w + (w - 1)
-        self.tree.levels = _get_mirror_rebuild_jit()(
-            self.cols, np.uint32(n), use_kernel=_use_pallas())
+        self.tree.levels = _mirror_levels(self.cols, n)
         self.tree.shared = False
         self.note_residency()
         return self.tree.root_words()
@@ -968,12 +1031,14 @@ class DeviceRegistryMirror:
         pad rows are masked at rebuild, their values never hashed).
         Returns True when the width changed (caller must rebuild)."""
         import jax.numpy as jnp
+        from ..parallel.mesh import mesh_place
         w = self.width
         if new_w <= w:
             return False
         for k, v in self.cols.items():
             pad = jnp.zeros((new_w - w,) + v.shape[1:], dtype=v.dtype)
-            self.cols[k] = jnp.concatenate([v, pad], axis=0)
+            self.cols[k] = mesh_place(
+                "registry_cols", jnp.concatenate([v, pad], axis=0))
         self.shared = False  # concat produced buffers only we hold
         self.note_residency()
         return True

@@ -1,0 +1,155 @@
+"""PR 20: the one named mesh — per-subsystem 8-vs-1 differentials plus
+the column-registry / reshard-seam unit surface.
+
+conftest already forces 8 virtual CPU devices process-wide; the
+``mesh8`` fixture flips the mesh knob so the residency layer actually
+shards over them (the knob's CPU default is the 1-device degenerate,
+which is what the whole rest of the suite runs on).
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.parallel import mesh as pmesh
+from lighthouse_tpu.parallel import mesh_slot as MS
+
+
+@pytest.fixture
+def mesh8(monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MESH_DEVICES", "8")
+    pmesh.reset_mesh()
+    yield pmesh
+    # monkeypatch restores the env after this; the next get_mesh() call
+    # re-reads the knob, so only the cache must be dropped here.
+    pmesh.reset_mesh()
+
+
+# -- differentials: every re-homed subsystem, 8-device vs 1-device -------
+
+@pytest.mark.parametrize("subsystem",
+                         ["tree", "registry", "packed", "forkchoice",
+                          "slasher"])
+def test_subsystem_sharded_bit_identical(mesh8, subsystem):
+    """The sharded mesh programs reuse the 1-device fold order, so every
+    observable output (roots, level stacks, heads, span planes) is
+    bit-identical across device counts."""
+    res = MS.check_subsystem(subsystem)
+    assert res["devices"] == 8
+    assert res["match"], f"{subsystem}: 8-device output diverged"
+
+
+def test_full_slot_model_digest_and_budget(mesh8):
+    """The composed slot — registry scatter/rebuild, packed root, fork
+    choice, slasher — stays bit-identical and inside the warm-slot
+    transfer budget at 8 devices."""
+    out8 = MS.run_slot_model(slots=2)
+    with MS.forced_devices(1):
+        out1 = MS.run_slot_model(slots=2)
+    assert out8["devices"] == 8 and out1["devices"] == 1
+    assert out8["digest"] == out1["digest"]
+    assert out8["budget"]["ok"], out8["budget"]
+    # the sharded columns produced one ledger row per shard
+    assert any(len(rows) == 8 for rows in out8["shards"].values())
+
+
+def test_knob_off_mid_life_rematerialize_round_trip(mesh8):
+    """De-materialize sharded residency to host, flip the knob off, and
+    re-materialize 1-device: same tree, same roots, warm scatter still
+    bit-identical — a mesh-size change is a restart-shaped event, never
+    a silent divergence."""
+    from lighthouse_tpu.ops.device_tree import DeviceTree
+    rng = np.random.default_rng(7)
+    leaves = rng.integers(0, 2 ** 32, (128, 8), dtype=np.uint32)
+    t8 = DeviceTree.from_host_leaves(leaves)
+    root8 = np.asarray(t8.root_words()).copy()
+    idx = np.asarray([0, 63, 127], np.int64)
+    rows = rng.integers(0, 2 ** 32, (3, 8), dtype=np.uint32)
+    scatter8 = np.asarray(t8.scatter(idx, rows)).copy()
+    pulled = t8.pull_levels()  # de-materialize through mesh_gather
+    with MS.forced_devices(1):
+        t1 = DeviceTree.from_host_leaves(leaves)
+        assert np.array_equal(np.asarray(t1.root_words()), root8)
+        assert np.array_equal(np.asarray(t1.scatter(idx, rows)),
+                              scatter8)
+        repulled = t1.pull_levels()
+    assert len(pulled) == len(repulled)
+    for a, b in zip(pulled, repulled):
+        assert np.array_equal(a, b)
+
+
+# -- the residency layer's own surface -----------------------------------
+
+def test_mesh_devices_knob_clamps_and_degenerates(monkeypatch):
+    import jax
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MESH_DEVICES", "64")
+    pmesh.reset_mesh()
+    assert pmesh.mesh_devices() == len(jax.devices())  # clamped
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MESH_DEVICES", "1")
+    pmesh.reset_mesh()
+    assert pmesh.axis_size() == 1
+    # auto on a CPU backend degenerates to 1 (tier-1 stays 1-device)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MESH_DEVICES", "0")
+    pmesh.reset_mesh()
+    assert pmesh.mesh_devices() == 1
+    pmesh.reset_mesh()
+
+
+def test_register_column_idempotent_and_conflicting(mesh8):
+    from jax.sharding import PartitionSpec as P
+    spec = pmesh.COLUMNS["tree_leaves"]
+    # identical re-registration is a no-op
+    pmesh.register_column("tree_leaves", spec.spec,
+                          subsystem=spec.subsystem, dtype=spec.dtype,
+                          pad_bucket=spec.pad_bucket, doc=spec.doc)
+    with pytest.raises(ValueError):
+        pmesh.register_column("tree_leaves", P(),
+                              subsystem="device_tree")
+
+
+def test_non_divisible_shape_falls_back_to_replicated(mesh8):
+    from jax.sharding import PartitionSpec as P
+    sh = pmesh.column_sharding("tree_leaves", shape=(10, 8))
+    assert sh.spec == P()  # 10 % 8 != 0: degrade, don't crash
+    sh = pmesh.column_sharding("tree_leaves", shape=(16, 8))
+    assert sh.spec == P(pmesh.BATCH_AXIS)
+
+
+def test_per_shard_ledger_rows(mesh8):
+    from lighthouse_tpu.common.device_ledger import LEDGER
+    LEDGER.reset()
+    arr = np.zeros((256, 8), np.uint32)
+    dev = pmesh.mesh_put("tree_leaves", arr)
+    shards = LEDGER.shard_totals()["device_tree"]
+    assert set(shards) == set(range(8))
+    assert all(row["h2d_bytes"] == arr.nbytes // 8
+               for row in shards.values())
+    # replicated family: every shard receives the full buffer
+    LEDGER.reset()
+    pidx = np.zeros(8, np.int64)
+    pmesh.mesh_put("tree_dirty", pidx)
+    shards = LEDGER.shard_totals()["device_tree"]
+    assert all(row["h2d_bytes"] == pidx.nbytes
+               for row in shards.values())
+    # d2h of a sharded array: 1/d per shard
+    LEDGER.reset()
+    out = pmesh.mesh_gather(dev, name="tree_leaves")
+    assert np.array_equal(out, arr)
+    shards = LEDGER.shard_totals()["device_tree"]
+    assert all(row["d2h_bytes"] == arr.nbytes // 8
+               for row in shards.values())
+    LEDGER.reset()
+
+
+def test_mesh_put_subsystem_attribution_order(mesh8):
+    from lighthouse_tpu.common.device_ledger import LEDGER
+    LEDGER.reset()
+    arr = np.zeros((16, 8), np.uint32)
+    # explicit beats the column's registered subsystem
+    pmesh.mesh_put("tree_leaves", arr, subsystem="staging")
+    assert "staging" in LEDGER.shard_totals()
+    LEDGER.reset()
+    # ambient beats the column default too
+    with LEDGER.attribute("packed_cache"):
+        pmesh.mesh_put("tree_leaves", arr)
+    assert "packed_cache" in LEDGER.shard_totals()
+    LEDGER.reset()
